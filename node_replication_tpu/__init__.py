@@ -1,0 +1,85 @@
+"""tpu-node-replication: a TPU-native node-replication framework.
+
+A brand-new framework with the capabilities of the reference
+`node-replication` Rust library (black-box replication of data structures
+through a shared operation log — see /root/reference, cited per-file in
+docstrings below), re-designed TPU-first:
+
+- Replica state is a JAX pytree of fixed-shape arrays; `Dispatch` is a set of
+  pure transition functions selected with `lax.switch` (replaces the Rust
+  `Dispatch` trait, `nr/src/lib.rs:103-125`).
+- The shared log is a device-resident struct-of-arrays ring buffer; `append`
+  is a batched reserve-then-write (replacing the CAS tail loop,
+  `nr/src/log.rs:391-418`) and `exec` is a vmapped `lax.scan` replay
+  (replacing the per-entry `alivef` spin loop, `nr/src/log.rs:473-524`).
+- Thousands of replicas replay the log in lock-step on one chip via `vmap`;
+  across chips, replicas shard over a `jax.sharding.Mesh` axis with the log
+  replicated (appends ride ICI as replicated computation; see
+  `node_replication_tpu.parallel`).
+- CNR (multi-log, commutativity-partitioned) becomes a stacked log axis that
+  can shard over a second mesh axis (`core/multilog.py`).
+
+Data arrays are int32 (TPU-native lane width); log cursors are int64 so
+logical positions never wrap (the reference relies on 64-bit `tail` never
+overflowing, `nr/src/log.rs:88-131`). We therefore enable jax x64 at import
+(opt out with NR_TPU_NO_X64=1; cursor math then wraps at 2^31).
+"""
+
+import os as _os
+
+import jax as _jax
+
+if not _os.environ.get("NR_TPU_NO_X64"):
+    _jax.config.update("jax_enable_x64", True)
+
+from node_replication_tpu.ops.encoding import (  # noqa: E402
+    Dispatch,
+    NOOP,
+    apply_read,
+    apply_write,
+    encode_ops,
+)
+from node_replication_tpu.core.log import (  # noqa: E402
+    DEFAULT_LOG_ENTRIES,
+    GC_FROM_HEAD,
+    LogSpec,
+    LogState,
+    log_append,
+    log_exec_all,
+    log_init,
+    log_reset,
+    log_space,
+    is_replica_synced_for_reads,
+)
+from node_replication_tpu.core.replica import (  # noqa: E402
+    MAX_PENDING_OPS,
+    MAX_THREADS_PER_REPLICA,
+    NodeReplicated,
+    ReplicaToken,
+)
+from node_replication_tpu.core.step import make_step  # noqa: E402
+
+__all__ = [
+    "Dispatch",
+    "NOOP",
+    "apply_read",
+    "apply_write",
+    "encode_ops",
+    "DEFAULT_LOG_ENTRIES",
+    "GC_FROM_HEAD",
+    "LogSpec",
+    "LogState",
+    "log_append",
+    "log_exec_all",
+    "log_init",
+    "log_reset",
+    "log_space",
+    "is_replica_synced_for_reads",
+    "MAX_PENDING_OPS",
+    "MAX_THREADS_PER_REPLICA",
+    "NodeReplicated",
+    "ReplicaToken",
+    "make_step",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,30 @@
+from node_replication_tpu.core.log import (
+    DEFAULT_LOG_ENTRIES,
+    GC_FROM_HEAD,
+    LogSpec,
+    LogState,
+    is_replica_synced_for_reads,
+    log_append,
+    log_exec_all,
+    log_init,
+    log_reset,
+    log_space,
+)
+from node_replication_tpu.core.replica import NodeReplicated, ReplicaToken
+from node_replication_tpu.core.step import make_step
+
+__all__ = [
+    "DEFAULT_LOG_ENTRIES",
+    "GC_FROM_HEAD",
+    "LogSpec",
+    "LogState",
+    "is_replica_synced_for_reads",
+    "log_append",
+    "log_exec_all",
+    "log_init",
+    "log_reset",
+    "log_space",
+    "NodeReplicated",
+    "ReplicaToken",
+    "make_step",
+]

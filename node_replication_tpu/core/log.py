@@ -1,0 +1,221 @@
+"""The shared operation log as a device-resident ring buffer.
+
+TPU-native re-design of the reference's lock-free MPMC ring
+(`nr/src/log.rs`). The mapping (SURVEY.md §2.6, §7):
+
+- `Entry<T>` cells with `alivef` liveness bits (`nr/src/log.rs:51-65`) become
+  a struct-of-arrays ring `(opcodes: int32[L], args: int32[L, A])`. Liveness
+  parity (`lmasks`) disappears entirely: within a lock-step append→replay
+  step, append happens-before replay by data dependence, so an entry is live
+  iff its logical position is `< tail`.
+- The CAS tail-reservation loop (`nr/src/log.rs:391-399`) becomes a batched
+  reserve-then-write: the caller presents a fixed-shape batch plus a valid
+  count; slots `[tail, tail+count)` are filled with one masked scatter and
+  `tail` advances once. Cross-replica batches are concatenated by the step
+  builder (`core/step.py`) with prefix-sum offsets — the whole-fleet append
+  is one scatter, no contention point at all.
+- `exec` (`nr/src/log.rs:473-524`) becomes `log_exec_all`: a `lax.scan` over
+  a static replay window, vmapped over replicas, each starting from its own
+  `ltails[r]` with per-position `pos < tail` masking (per-replica divergent
+  progress, SURVEY.md §7 "hard parts").
+- `advance_head` GC (`nr/src/log.rs:536-580`) is the reduction
+  `head = min(ltails)`, folded into `log_exec_all`. "Help replay before
+  appending when full" (`nr/src/log.rs:364-387`) becomes the host-side rule:
+  if `log_space` cannot fit the batch, run replay windows first
+  (`core/replica.py`).
+- `ctail` (completed tail, `nr/src/log.rs:520-523` fetch_max) is
+  `max(ctail, max(new ltails))`.
+
+Logical positions (`head`/`tail`/`ctail`/`ltails`) are monotonically
+increasing int64 scalars; the physical slot is `pos & (L-1)` with L a power
+of two (`nr/src/log.rs:194-196`, `527-530`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from node_replication_tpu.ops.encoding import Dispatch, NOOP, apply_write
+
+PyTree = Any
+
+# Default number of log entries. The reference defaults to 32 MiB of 64-byte
+# entries = 2^19 slots "based on the ASPLOS 2017 paper" (`nr/src/log.rs:19-22`);
+# device HBM is more precious than DRAM, and a 2^16-entry ring already covers
+# the largest single-step replay window we schedule.
+DEFAULT_LOG_ENTRIES = 1 << 16
+
+# GC slack: an appender must leave this many slots between tail and head so
+# laggards can catch up before slots are overwritten. The reference uses
+# MAX_PENDING_OPS * MAX_THREADS_PER_REPLICA = 8192 (`nr/src/log.rs:36`).
+GC_FROM_HEAD = 8192
+
+# Spin-diagnostic threshold analog: after this many fruitless host-side
+# replay rounds the watchdog warns (`nr/src/log.rs:43` WARN_THRESHOLD).
+WARN_ROUNDS = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class LogSpec:
+    """Static log configuration (hashable: used as a jit static argument).
+
+    `capacity` is rounded up to a power of two with a floor of
+    `2 * gc_slack`, mirroring `Log::new` (`nr/src/log.rs:184-196`).
+    """
+
+    capacity: int = DEFAULT_LOG_ENTRIES
+    n_replicas: int = 1
+    arg_width: int = 3
+    gc_slack: int = GC_FROM_HEAD
+
+    def __post_init__(self):
+        cap = max(int(self.capacity), 2 * self.gc_slack)
+        cap = 1 << (cap - 1).bit_length()  # next power of two
+        object.__setattr__(self, "capacity", cap)
+        if self.n_replicas < 1:
+            raise ValueError("need at least one replica")
+
+    @property
+    def mask(self) -> int:
+        return self.capacity - 1
+
+
+class LogState(NamedTuple):
+    """Device-resident log: ring arrays + monotone int64 cursors."""
+
+    opcodes: jax.Array  # int32[L]
+    args: jax.Array  # int32[L, A]
+    head: jax.Array  # int64 scalar
+    tail: jax.Array  # int64 scalar
+    ctail: jax.Array  # int64 scalar (completed tail)
+    ltails: jax.Array  # int64[R] (per-replica local tails)
+
+
+def log_init(spec: LogSpec) -> LogState:
+    """Allocate an empty log (`Log::new`, `nr/src/log.rs:179-241`)."""
+    return LogState(
+        opcodes=jnp.full((spec.capacity,), NOOP, jnp.int32),
+        args=jnp.zeros((spec.capacity, spec.arg_width), jnp.int32),
+        head=jnp.zeros((), jnp.int64),
+        tail=jnp.zeros((), jnp.int64),
+        ctail=jnp.zeros((), jnp.int64),
+        ltails=jnp.zeros((spec.n_replicas,), jnp.int64),
+    )
+
+
+def log_reset(spec: LogSpec, log: LogState) -> LogState:
+    """Zero the log for bench reuse (`Log::reset`, `nr/src/log.rs:593-611`)."""
+    del log
+    return log_init(spec)
+
+
+def log_space(spec: LogSpec, log: LogState) -> jax.Array:
+    """Free slots an append may consume while preserving the GC slack
+    (`nr/src/log.rs:364-387`)."""
+    used = log.tail - log.head
+    return jnp.maximum(spec.capacity - spec.gc_slack - used, 0)
+
+
+def log_append(
+    spec: LogSpec,
+    log: LogState,
+    opcodes: jax.Array,
+    args: jax.Array,
+    count: jax.Array | int,
+) -> LogState:
+    """Batched reserve-then-write of `count` valid slots from a fixed-shape
+    batch (`Log::append`, `nr/src/log.rs:343-427`, minus the CAS loop).
+
+    Capacity is NOT checked here (jit-hot path); callers go through
+    `log_space` / the replica layer's help-first rule, exactly as reference
+    appenders must help GC before appending.
+    """
+    batch = opcodes.shape[0]
+    count = jnp.asarray(count, jnp.int64)
+    lanes = jnp.arange(batch, dtype=jnp.int64)
+    valid = lanes < count
+    # Invalid lanes scatter to index L, which mode="drop" discards: the
+    # fixed-shape equivalent of only publishing `count` entries.
+    slot = jnp.where(
+        valid, (log.tail + lanes) & spec.mask, spec.capacity
+    ).astype(jnp.int32)
+    return log._replace(
+        opcodes=log.opcodes.at[slot].set(opcodes, mode="drop"),
+        args=log.args.at[slot].set(args, mode="drop"),
+        tail=log.tail + count,
+    )
+
+
+def _exec_one(
+    spec: LogSpec,
+    d: Dispatch,
+    log: LogState,
+    state: PyTree,
+    ltail: jax.Array,
+    window: int,
+):
+    """Replay up to `window` entries of `[ltail, tail)` into one replica.
+
+    The reference's hot replay loop (`nr/src/log.rs:473-524`): per entry,
+    spin on `alivef` then `dispatch_mut`. Here the spin is gone (liveness is
+    `pos < tail`) and the loop is a `lax.scan` whose body is one masked
+    `apply_write`.
+    """
+
+    def body(state, j):
+        pos = ltail + j
+        active = pos < log.tail
+        idx = (pos & spec.mask).astype(jnp.int32)
+        opcode = jnp.where(active, log.opcodes[idx], NOOP)
+        state, resp = apply_write(d, state, opcode, log.args[idx])
+        return state, resp
+
+    state, resps = lax.scan(body, state, jnp.arange(window, dtype=jnp.int64))
+    new_ltail = jnp.minimum(ltail + window, log.tail)
+    return state, resps, new_ltail
+
+
+def log_exec_all(
+    spec: LogSpec,
+    d: Dispatch,
+    log: LogState,
+    states: PyTree,
+    window: int,
+):
+    """Replay a static `window` of pending entries into every replica in
+    lock-step (vmapped `_exec_one`), then fold in progress bookkeeping:
+
+    - `ltails[r] = min(ltails[r] + window, tail)`,
+    - `ctail = max(ctail, max(ltails))`   (fetch_max, `nr/src/log.rs:520-523`),
+    - `head  = min(ltails)`               (GC, `nr/src/log.rs:536-580`).
+
+    Returns `(log, states, resps)` with `resps: int32[R, window]`;
+    `resps[r, i]` answers the entry at logical position `old_ltails[r] + i`.
+    """
+    states, resps, new_ltails = jax.vmap(
+        lambda s, lt: _exec_one(spec, d, log, s, lt, window)
+    )(states, log.ltails)
+    log = log._replace(
+        ltails=new_ltails,
+        ctail=jnp.maximum(log.ctail, jnp.max(new_ltails)),
+        head=jnp.min(new_ltails),
+    )
+    return log, states, resps
+
+
+def is_replica_synced_for_reads(
+    log: LogState, ridx: int, ctail: jax.Array
+) -> jax.Array:
+    """`nr/src/log.rs:671-675`: may replica `ridx` serve reads issued when
+    the completed tail was `ctail`?"""
+    return log.ltails[ridx] >= ctail
+
+
+def get_ctail(log: LogState) -> jax.Array:
+    """`nr/src/log.rs:677-679`."""
+    return log.ctail

@@ -1,0 +1,269 @@
+"""Multi-log node replication: the CNR (`cnr` crate) equivalent.
+
+The reference's `cnr` partitions the operation stream over many logs by a
+commutativity hash (`LogMapper`, `cnr/src/lib.rs:123-137`): conflicting ops
+must map to the same log; commutative ops may map to different logs and are
+then combined/replayed in parallel by per-log combiners
+(`cnr/src/replica.rs:93-98`, `430-445`).
+
+TPU-first re-design (SURVEY.md §7 "CNR"):
+
+- The L logs are one stacked `LogState` with a leading log axis
+  (`opcodes: int32[L, C]`, cursors `[L]`, `ltails: [L, R]`) — a pytree that
+  shards naturally over a `Mesh` 'log' axis (the tensor/expert-parallel
+  analog of the op stream, SURVEY.md §2.5 #3).
+- `LogMapper` is a host-side function `(opcode, args) -> hash`; the hash is
+  reduced `% nlogs` exactly as `cnr/src/replica.rs:435`.
+- Per-log combiner locks disappear (lock-step); what survives is that each
+  log gets its own independent append batch and its own replay scan —
+  `vmap` over the log axis replaces parallel combiner threads, and
+  dispatch against shared replica state must be commutative across logs
+  within a step (the same contract `dispatch_mut(&self)` demands of the
+  user's concurrent DS, `cnr/src/lib.rs:167`).
+- Reads sync only their mapped log (`cnr/src/replica.rs:599-617`);
+  `sync_log` targets one log (`cnr/src/replica.rs:579-597`).
+
+Replay layout: `multilog_exec_all` vmaps the single-log scan over
+(log × replica). Because ops on different logs commute by contract, applying
+each log's span to disjoint *state partitions* is exact. The bundled
+partitioned models (`models/partitioned.py`) expose
+`state_partition(state, log_idx, nlogs)` views; for monolithic states the
+scan falls back to sequential per-log folding (`fold_logs=True`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from node_replication_tpu.core.log import LogSpec
+from node_replication_tpu.ops.encoding import Dispatch, NOOP, apply_write
+
+PyTree = Any
+
+# LogMapper: host-side commutativity hash (`cnr/src/lib.rs:123-137`).
+LogMapper = Callable[[int, tuple], int]
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiLogSpec:
+    """Static config for a stacked multi-log (hashable jit static)."""
+
+    nlogs: int
+    capacity: int = 1 << 14
+    n_replicas: int = 1
+    arg_width: int = 3
+    gc_slack: int = 1024
+
+    def __post_init__(self):
+        cap = max(int(self.capacity), 2 * self.gc_slack)
+        cap = 1 << (cap - 1).bit_length()
+        object.__setattr__(self, "capacity", cap)
+        if self.nlogs < 1:
+            raise ValueError("need at least one log")
+
+    @property
+    def mask(self) -> int:
+        return self.capacity - 1
+
+    def one_log(self) -> LogSpec:
+        return LogSpec(
+            capacity=self.capacity,
+            n_replicas=self.n_replicas,
+            arg_width=self.arg_width,
+            gc_slack=self.gc_slack,
+        )
+
+
+class MultiLogState(NamedTuple):
+    """L stacked rings; every cursor grows a leading log axis.
+
+    Mirrors `cnr`'s `slog: Vec<Arc<Log>>` + per-log registration
+    (`cnr/src/replica.rs:93-98`) as one shardable pytree.
+    """
+
+    opcodes: jax.Array  # int32[L, C]
+    args: jax.Array  # int32[L, C, A]
+    head: jax.Array  # int64[L]
+    tail: jax.Array  # int64[L]
+    ctail: jax.Array  # int64[L]
+    ltails: jax.Array  # int64[L, R]
+
+
+def multilog_init(spec: MultiLogSpec) -> MultiLogState:
+    L, C = spec.nlogs, spec.capacity
+    return MultiLogState(
+        opcodes=jnp.full((L, C), NOOP, jnp.int32),
+        args=jnp.zeros((L, C, spec.arg_width), jnp.int32),
+        head=jnp.zeros((L,), jnp.int64),
+        tail=jnp.zeros((L,), jnp.int64),
+        ctail=jnp.zeros((L,), jnp.int64),
+        ltails=jnp.zeros((L, spec.n_replicas), jnp.int64),
+    )
+
+
+def multilog_space(spec: MultiLogSpec, ml: MultiLogState) -> jax.Array:
+    return jnp.maximum(
+        spec.capacity - spec.gc_slack - (ml.tail - ml.head), 0
+    )
+
+
+def multilog_append(
+    spec: MultiLogSpec,
+    ml: MultiLogState,
+    opcodes: jax.Array,  # int32[L, B] — already partitioned per log
+    args: jax.Array,  # int32[L, B, A]
+    counts: jax.Array,  # int64[L] — valid prefix per log
+) -> MultiLogState:
+    """Per-log batched append (each log's combiner append,
+    `cnr/src/replica.rs:708`, vectorized over the log axis)."""
+    B = opcodes.shape[1]
+    lanes = jnp.arange(B, dtype=jnp.int64)[None, :]
+    counts = jnp.asarray(counts, jnp.int64)
+    valid = lanes < counts[:, None]
+    slot = jnp.where(
+        valid, (ml.tail[:, None] + lanes) & spec.mask, spec.capacity
+    ).astype(jnp.int32)
+
+    def scatter_one(ring, slots, vals):
+        return ring.at[slots].set(vals, mode="drop")
+
+    return ml._replace(
+        opcodes=jax.vmap(scatter_one)(ml.opcodes, slot, opcodes),
+        args=jax.vmap(scatter_one)(ml.args, slot, args),
+        tail=ml.tail + counts,
+    )
+
+
+def _exec_one_log(spec, d, opcodes_ring, args_ring, tail, state, ltail,
+                  window: int):
+    """Single (log, replica) replay scan — same algorithm as
+    `core/log.py:_exec_one` over one ring of the stack."""
+
+    def body(state, j):
+        pos = ltail + j
+        active = pos < tail
+        idx = (pos & spec.mask).astype(jnp.int32)
+        opcode = jnp.where(active, opcodes_ring[idx], NOOP)
+        state, resp = apply_write(d, state, opcode, args_ring[idx])
+        return state, resp
+
+    state, resps = lax.scan(body, state, jnp.arange(window, dtype=jnp.int64))
+    return state, resps, jnp.minimum(ltail + window, tail)
+
+
+def multilog_exec_all(
+    spec: MultiLogSpec,
+    d: Dispatch,
+    ml: MultiLogState,
+    states: PyTree,
+    window: int,
+    state_partition: Callable | None = None,
+):
+    """Replay `window` pending entries of every log into every replica.
+
+    With `state_partition(state, log_idx, nlogs) -> (sub, merge_fn)` the L
+    per-log scans run fully vmapped over disjoint state partitions (the
+    parallel-combining payoff, `cnr/src/replica.rs:713-720`). Without it,
+    logs fold sequentially per replica (still correct for any state; ops on
+    different logs commute by the LogMapper contract so order is free).
+
+    Returns `(ml, states, resps[L, R, window])`.
+    """
+    if state_partition is not None:
+        subs = []
+        merges = []
+        for l in range(spec.nlogs):
+            sub, merge = state_partition(states, l, spec.nlogs)
+            subs.append(sub)
+            merges.append(merge)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *subs)
+
+        def per_log(opc, arg, tail, sub_states, ltails):
+            return jax.vmap(
+                lambda s, lt: _exec_one_log(
+                    spec, d, opc, arg, tail, s, lt, window
+                )
+            )(sub_states, ltails)
+
+        new_subs, resps, new_ltails = jax.vmap(per_log)(
+            ml.opcodes, ml.args, ml.tail, stacked, ml.ltails
+        )
+        for l in range(spec.nlogs):
+            states = merges[l](
+                states, jax.tree.map(lambda x, _l=l: x[_l], new_subs)
+            )
+    else:
+        resps_list = []
+        ltails_list = []
+        for l in range(spec.nlogs):
+            states, resps_l, lt_l = jax.vmap(
+                lambda s, lt, _l=l: _exec_one_log(
+                    spec, d, ml.opcodes[_l], ml.args[_l], ml.tail[_l],
+                    s, lt, window,
+                )
+            )(states, ml.ltails[l])
+            resps_list.append(resps_l)
+            ltails_list.append(lt_l)
+        resps = jnp.stack(resps_list)
+        new_ltails = jnp.stack(ltails_list)
+
+    ml = ml._replace(
+        ltails=new_ltails,
+        ctail=jnp.maximum(ml.ctail, jnp.max(new_ltails, axis=1)),
+        head=jnp.min(new_ltails, axis=1),
+    )
+    return ml, states, resps
+
+
+def is_log_synced_for_reads(
+    ml: MultiLogState, log_idx: int, ridx: int, ctail: jax.Array
+) -> jax.Array:
+    """Reads sync only their mapped log (`cnr/src/replica.rs:599-617`)."""
+    return ml.ltails[log_idx, ridx] >= ctail
+
+
+def partition_ops(
+    mapper: LogMapper,
+    nlogs: int,
+    ops: list[tuple[int, tuple]],
+    arg_width: int,
+    pad_to: int | None = None,
+):
+    """Host-side LogMapper application: split an op list into per-log
+    fixed-shape batches (`hash % nlogs`, `cnr/src/replica.rs:435`).
+
+    Returns `(opcodes int32[L, B], args int32[L, B, A], counts int64[L],
+    placements)` where `placements[i] = (log, slot)` for op i.
+    """
+    import numpy as np
+
+    buckets: list[list[tuple[int, tuple]]] = [[] for _ in range(nlogs)]
+    placements = []
+    for opcode, args in ops:
+        h = mapper(opcode, args) % nlogs
+        placements.append((h, len(buckets[h])))
+        buckets[h].append((opcode, args))
+    B = pad_to if pad_to is not None else max(
+        1, max(len(b) for b in buckets)
+    )
+    opcodes = np.full((nlogs, B), NOOP, np.int32)
+    args_arr = np.zeros((nlogs, B, arg_width), np.int32)
+    counts = np.zeros((nlogs,), np.int64)
+    for l, bucket in enumerate(buckets):
+        if len(bucket) > B:
+            raise ValueError(f"log {l} bucket {len(bucket)} > pad {B}")
+        counts[l] = len(bucket)
+        for j, (opcode, a) in enumerate(bucket):
+            opcodes[l, j] = opcode
+            args_arr[l, j, : len(a)] = a
+    return (
+        jnp.asarray(opcodes),
+        jnp.asarray(args_arr),
+        jnp.asarray(counts),
+        placements,
+    )

@@ -1,0 +1,339 @@
+"""Replica runtime: flat-combining batching + lock-step replay.
+
+The TPU re-design of `nr/src/replica.rs`. What changes and why
+(SURVEY.md §7):
+
+- The reference elects a combiner thread with a CAS lock
+  (`nr/src/replica.rs:508-540`) because threads race; replay here is a
+  lock-step device computation, so combiner *election* is meaningless. What
+  survives is the *batching* contract: per-thread `Context` rings are
+  drained whole, in thread order, into one append batch per replica
+  (`Replica::combine`, `nr/src/replica.rs:543-595`).
+- `data: CachePadded<RwLock<D>>` (`nr/src/replica.rs:108-114`) becomes a
+  vmapped pytree with a leading replica axis — functional state needs no
+  reader/writer lock (SURVEY.md §7 "RwLock → unnecessary on-device"). A
+  native C++ distributed RwLock still backs the CPU engine
+  (`node_replication_tpu/native/`).
+- `execute_mut` = stage → combine → collect response
+  (`nr/src/replica.rs:345-356`); `execute` (read) waits until this replica's
+  ltail passes the completed tail, helping replay while it waits, then
+  dispatches locally (`nr/src/replica.rs:404-410`, `483-497`).
+- "Append must help GC when the log is full" (`nr/src/log.rs:364-387`)
+  becomes: run replay windows until `log_space` fits the batch.
+- The reference's spin-diagnostic `WARN_THRESHOLD` warnings
+  (`nr/src/log.rs:43`) become a host-side watchdog: after `WARN_ROUNDS`
+  replay rounds without progress, a structured warning fires and the
+  CNR-style GC starvation callback (`cnr/src/log.rs:135-142`) is invoked
+  with the most dormant replica.
+
+`NodeReplicated` is the stateful convenience wrapper (per-op API parity with
+the reference examples, `nr/examples/hashmap.rs:55-105`); the jit-hot batch
+path is `core/step.py`.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from node_replication_tpu.core.log import (
+    LogSpec,
+    WARN_ROUNDS,
+    log_append,
+    log_exec_all,
+    log_init,
+    log_space,
+)
+from node_replication_tpu.ops.context import MAX_PENDING_OPS, Context
+from node_replication_tpu.ops.encoding import (
+    Dispatch,
+    apply_read,
+    encode_ops,
+)
+
+logger = logging.getLogger("node_replication_tpu")
+
+# Max logical threads per replica (`nr/src/replica.rs:56`).
+MAX_THREADS_PER_REPLICA = 256
+
+# Default static replay window per device round (jit-compiled once).
+DEFAULT_EXEC_WINDOW = 256
+
+
+class ReplicaToken(NamedTuple):
+    """Registration handle (`ReplicaToken`, `nr/src/replica.rs:27-30`).
+
+    The reference makes it `!Send` to pin it to a thread; here it is just an
+    index pair the caller must not share across logical threads.
+    """
+
+    rid: int
+    tid: int
+
+
+class LogTooSmallError(RuntimeError):
+    """A single batch exceeds the log's appendable capacity."""
+
+
+def replicate_state(state, n_replicas: int):
+    """Stack one replica state into an [R, ...] lock-step fleet."""
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(
+            x[None], (n_replicas,) + x.shape
+        ).copy(),
+        state,
+    )
+
+
+class NodeReplicated:
+    """N replicas of one `Dispatch` data structure behind a shared log.
+
+    Mirrors the user-facing surface of `Replica` + `Log` wiring from the
+    reference examples: `register`, `execute_mut`, `execute`, `sync`,
+    `verify`, plus batched `enqueue_mut`/`flush` (the flat-combining fast
+    path made explicit).
+    """
+
+    def __init__(
+        self,
+        dispatch: Dispatch,
+        n_replicas: int = 1,
+        log_entries: int | None = None,
+        gc_slack: int | None = None,
+        exec_window: int = DEFAULT_EXEC_WINDOW,
+        gc_callback: Callable[[int, int], None] | None = None,
+    ):
+        kw = {}
+        if log_entries is not None:
+            kw["capacity"] = log_entries
+        if gc_slack is not None:
+            kw["gc_slack"] = gc_slack
+        self.spec = LogSpec(
+            n_replicas=n_replicas, arg_width=dispatch.arg_width, **kw
+        )
+        self.dispatch = dispatch
+        self.exec_window = int(exec_window)
+        self.gc_callback = gc_callback
+
+        self.log = log_init(self.spec)
+        self.states = replicate_state(dispatch.init_state(), n_replicas)
+
+        self._contexts: dict[tuple[int, int], Context] = {}
+        self._threads_per_replica = [0] * n_replicas
+        # Appended-but-unanswered ops per replica: deque[(logical_pos, tid)].
+        self._inflight: list[deque] = [deque() for _ in range(n_replicas)]
+
+        self._exec_jit = jax.jit(
+            partial(log_exec_all, self.spec, dispatch),
+            static_argnames=("window",),
+            donate_argnums=(0, 1),
+        )
+        self._append_jit = jax.jit(
+            partial(log_append, self.spec), donate_argnums=(0,)
+        )
+
+        def _read_one(states, rid, opcode, args):
+            state = jax.tree.map(lambda a: a[rid], states)
+            return apply_read(dispatch, state, opcode, args)
+
+        self._read_jit = jax.jit(_read_one)
+
+    # ------------------------------------------------------------------ API
+
+    @property
+    def n_replicas(self) -> int:
+        return self.spec.n_replicas
+
+    def register(self, rid: int = 0) -> ReplicaToken:
+        """Register a logical thread on replica `rid`
+        (`Replica::register`, `nr/src/replica.rs:279-298`)."""
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        tid = self._threads_per_replica[rid]
+        if tid >= MAX_THREADS_PER_REPLICA:
+            raise RuntimeError(
+                f"replica {rid} already has {MAX_THREADS_PER_REPLICA} threads"
+            )
+        self._threads_per_replica[rid] = tid + 1
+        self._contexts[(rid, tid)] = Context()
+        return ReplicaToken(rid, tid)
+
+    def execute_mut(self, op: tuple, token: ReplicaToken):
+        """Stage one write op, combine, and return its response
+        (`Replica::execute_mut`, `nr/src/replica.rs:345-356`)."""
+        ctx = self._contexts[(token.rid, token.tid)]
+        if not ctx.enqueue(op[0], tuple(op[1:])):
+            self.combine(token.rid)
+            ctx.enqueue(op[0], tuple(op[1:]))
+        self.combine(token.rid)
+        resp = None
+        r = ctx.res()
+        while r is not None:  # drain any enqueue_mut backlog; last is ours
+            resp = r
+            r = ctx.res()
+        return resp
+
+    def enqueue_mut(self, op: tuple, token: ReplicaToken) -> None:
+        """Stage a write without combining (explicit flat-combining batch
+        building). Combines first if this thread's 32-slot ring is full."""
+        ctx = self._contexts[(token.rid, token.tid)]
+        if not ctx.enqueue(op[0], tuple(op[1:])):
+            self.combine(token.rid)
+            ctx.enqueue(op[0], tuple(op[1:]))
+
+    def flush(self, rid: int | None = None) -> None:
+        """Combine pending batches (all replicas by default)."""
+        for r in range(self.n_replicas) if rid is None else [rid]:
+            self.combine(r)
+
+    def responses(self, token: ReplicaToken) -> list:
+        """Drain delivered responses for this thread, in enqueue order."""
+        ctx = self._contexts[(token.rid, token.tid)]
+        out = []
+        r = ctx.res()
+        while r is not None:
+            out.append(r)
+            r = ctx.res()
+        return out
+
+    def execute(self, op: tuple, token: ReplicaToken):
+        """Read path (`Replica::execute` → `read_only`,
+        `nr/src/replica.rs:404-410`, `483-497`): wait until this replica has
+        replayed up to the completed tail (helping replay while waiting),
+        then dispatch locally against replica state."""
+        rid = token.rid
+        ctail = int(self.log.ctail)
+        rounds = 0
+        while int(np.asarray(self.log.ltails)[rid]) < ctail:
+            self._exec_round()
+            rounds = self._watchdog(rounds, "read-sync")
+        args = np.zeros((self.spec.arg_width,), np.int32)
+        args[: len(op) - 1] = op[1:]
+        return int(
+            self._read_jit(
+                self.states,
+                jnp.int32(rid),
+                jnp.int32(op[0]),
+                jnp.asarray(args),
+            )
+        )
+
+    def combine(self, rid: int) -> None:
+        """Drain this replica's thread contexts (thread order —
+        `nr/src/replica.rs:555-557`), append the batch, and replay until
+        this replica has applied its own ops (`nr/src/replica.rs:543-595`).
+        Responses are delivered to every replica's contexts as replay
+        progresses."""
+        ops: list[tuple[int, int, tuple]] = []  # (tid, opcode, args)
+        for tid in range(self._threads_per_replica[rid]):
+            for opcode, args in self._contexts[(rid, tid)].ops():
+                ops.append((tid, opcode, args))
+        n = len(ops)
+        if n == 0:
+            self._exec_round()  # combine with nothing staged still helps
+            return
+
+        max_batch = self.spec.capacity - self.spec.gc_slack
+        if n > max_batch:
+            raise LogTooSmallError(
+                f"batch of {n} exceeds appendable capacity {max_batch}"
+            )
+        rounds = 0
+        while int(log_space(self.spec, self.log)) < n:
+            self._exec_round()
+            rounds = self._watchdog(rounds, "append-gc")
+
+        pos0 = int(self.log.tail)
+        pad = 1 << (max(n, 1) - 1).bit_length()
+        opcodes, args, _ = encode_ops(
+            [(o, *a) for _, o, a in ops], self.spec.arg_width, pad_to=pad
+        )
+        self.log = self._append_jit(self.log, opcodes, args, n)
+        inflight = self._inflight[rid]
+        for j, (tid, _, _) in enumerate(ops):
+            inflight.append((pos0 + j, tid))
+
+        target = pos0 + n
+        rounds = 0
+        while int(np.asarray(self.log.ltails)[rid]) < target:
+            self._exec_round()
+            rounds = self._watchdog(rounds, "combine-replay")
+
+    def sync(self, rid: int | None = None) -> None:
+        """Catch replicas up with the log tail (`Replica::sync`,
+        `nr/src/replica.rs:469-479`); `rid=None` syncs all."""
+        rounds = 0
+        while True:
+            ltails = np.asarray(self.log.ltails)
+            tail = int(self.log.tail)
+            done = (
+                all(int(lt) >= tail for lt in ltails)
+                if rid is None
+                else int(ltails[rid]) >= tail
+            )
+            if done:
+                return
+            self._exec_round()
+            rounds = self._watchdog(rounds, "sync")
+
+    def verify(self, fn: Callable[[Any], Any], rid: int = 0):
+        """Test hook (`Replica::verify`, `nr/src/replica.rs:443-467`):
+        force-sync, then expose replica `rid`'s state (as host numpy pytree)
+        to `fn` for assertions."""
+        self.sync()
+        state = jax.tree.map(lambda a: np.asarray(a[rid]), self.states)
+        return fn(state)
+
+    def replicas_equal(self) -> bool:
+        """All replicas converged to identical state (the
+        `replicas_are_equal` idiom, `nr/tests/stack.rs:434-489`)."""
+        leaves = jax.tree.leaves(
+            jax.tree.map(
+                lambda a: bool(np.all(np.asarray(a) == np.asarray(a)[0:1])),
+                self.states,
+            )
+        )
+        return all(leaves)
+
+    # ------------------------------------------------------------ internals
+
+    def _exec_round(self) -> bool:
+        """One static-window replay round for every replica, plus response
+        distribution. Returns True if any replica made progress."""
+        ltails_before = np.asarray(self.log.ltails).copy()
+        self.log, self.states, resps = self._exec_jit(
+            self.log, self.states, window=self.exec_window
+        )
+        ltails_after = np.asarray(self.log.ltails)
+        resps_np = np.asarray(resps)
+        for r in range(self.n_replicas):
+            q = self._inflight[r]
+            while q and q[0][0] < int(ltails_after[r]):
+                pos, tid = q.popleft()
+                self._contexts[(r, tid)].enqueue_resps(
+                    [int(resps_np[r, pos - int(ltails_before[r])])]
+                )
+        return bool(np.any(ltails_after > ltails_before))
+
+    def _watchdog(self, rounds: int, where: str) -> int:
+        rounds += 1
+        if rounds == WARN_ROUNDS:
+            dormant = int(np.argmin(np.asarray(self.log.ltails)))
+            logger.warning(
+                "replay stalled in %s after %d rounds; most dormant "
+                "replica=%d (ltail=%d, tail=%d)",
+                where,
+                rounds,
+                dormant,
+                int(np.asarray(self.log.ltails)[dormant]),
+                int(self.log.tail),
+            )
+            if self.gc_callback is not None:
+                self.gc_callback(0, dormant)
+        return rounds

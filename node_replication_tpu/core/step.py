@@ -1,0 +1,99 @@
+"""Fused append→replay→read step: the jit-hot batch path.
+
+This is the TPU answer to the reference's whole write+read pipeline
+(`nr/src/replica.rs:345-356` staging → `nr/src/log.rs:343-427` append →
+`nr/src/log.rs:473-524` replay → `nr/src/replica.rs:483-497` read): one
+compiled XLA program per step that
+
+1. concatenates every replica's write batch in replica-major order — the
+   linearization point; the batched substitute for per-combiner CAS tail
+   reservations (offsets are a static prefix sum since batches are
+   fixed-shape),
+2. appends the combined batch to the device-resident log,
+3. replays the exact appended window into all replicas (vmapped scan),
+4. answers each replica's read batch against its own post-replay state —
+   read-your-writes holds by construction, which is precisely the
+   `ltail >= ctail` read gate of the reference in lock-step form.
+
+Precondition: all replicas are synced (`ltails == tail`) when the step
+begins — true by induction since each step replays exactly what it appends.
+Use `NodeReplicated` when replicas drift.
+
+The returned step function is pure and shape-stable, so it can be jitted
+with sharding annotations (see `node_replication_tpu/parallel/mesh.py`) to
+run the replica axis across a TPU mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from node_replication_tpu.core.log import (
+    LogSpec,
+    log_append,
+    log_exec_all,
+)
+from node_replication_tpu.ops.encoding import Dispatch, apply_read
+
+
+def make_step(
+    dispatch: Dispatch,
+    spec: LogSpec,
+    writes_per_replica: int,
+    reads_per_replica: int,
+    jit: bool = True,
+    donate: bool = True,
+):
+    """Build `step(log, states, wr_opcodes, wr_args, rd_opcodes, rd_args)`.
+
+    Shapes (R = spec.n_replicas, Bw/Br = writes/reads per replica,
+    A = spec.arg_width):
+
+      wr_opcodes int32[R, Bw], wr_args int32[R, Bw, A]
+      rd_opcodes int32[R, Br], rd_args int32[R, Br, A]
+
+    Returns `(log, states, wr_resps int32[R, Bw], rd_resps int32[R, Br])`
+    where `wr_resps[r, j]` answers replica r's j-th write (produced by r's
+    own replay of its own entry — the reference's response-distribution
+    contract, `nr/src/replica.rs:584-594`) and `rd_resps[r, j]` answers its
+    j-th read. NOOP-padded slots answer 0.
+    """
+    R = spec.n_replicas
+    Bw = int(writes_per_replica)
+    Br = int(reads_per_replica)
+    span = R * Bw
+    max_batch = spec.capacity - spec.gc_slack
+    if span > max_batch:
+        raise ValueError(
+            f"step appends {span} entries but log fits {max_batch}; "
+            f"grow LogSpec.capacity or shrink the per-step batch"
+        )
+
+    def step(log, states, wr_opcodes, wr_args, rd_opcodes, rd_args):
+        # 1-2. replica-major concatenation + one batched append.
+        log = log_append(
+            spec,
+            log,
+            wr_opcodes.reshape(span),
+            wr_args.reshape(span, spec.arg_width),
+            span,
+        )
+        # 3. replay exactly the appended window into every replica.
+        log, states, resps = log_exec_all(spec, dispatch, log, states, span)
+        # Replica r's own writes sit at window offsets [r*Bw, (r+1)*Bw).
+        own = jnp.arange(R, dtype=jnp.int32)[:, None] * Bw + jnp.arange(
+            Bw, dtype=jnp.int32
+        )[None, :]
+        wr_resps = jnp.take_along_axis(resps, own, axis=1)
+        # 4. per-replica read batches against post-replay local state.
+        rd_resps = jax.vmap(
+            lambda state, opcs, args: jax.vmap(
+                lambda o, a: apply_read(dispatch, state, o, a)
+            )(opcs, args)
+        )(states, rd_opcodes, rd_args)
+        return log, states, wr_resps, rd_resps
+
+    if jit:
+        step = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    return step

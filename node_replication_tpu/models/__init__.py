@@ -1,0 +1,23 @@
+from node_replication_tpu.models.hashmap import (
+    HM_GET,
+    HM_PUT,
+    HM_REMOVE,
+    make_hashmap,
+)
+from node_replication_tpu.models.stack import (
+    ST_PEEK,
+    ST_POP,
+    ST_PUSH,
+    make_stack,
+)
+
+__all__ = [
+    "HM_GET",
+    "HM_PUT",
+    "HM_REMOVE",
+    "make_hashmap",
+    "ST_PEEK",
+    "ST_POP",
+    "ST_PUSH",
+    "make_stack",
+]

@@ -1,0 +1,77 @@
+"""Replicated hash map, dense-keyspace variant.
+
+The reference's flagship workload (`benches/hashmap.rs:29-48`: a
+`HashMap<u64, u64>` with Put/Get behind NR). TPU-first re-design
+(SURVEY.md §7 "data-structure state as arrays"): the bench keyspace is
+bounded, so the map is a dense `values: int32[K]` + `present: bool[K]` pair,
+making every Put one scatter and every Get one gather — both vectorize
+perfectly across a vmapped replica axis. An open-addressing variant for
+sparse keyspaces lives in `models/oahashmap.py`.
+
+Write opcodes: HM_PUT=1 (args k, v → resp 0), HM_REMOVE=2 (args k → resp 1
+if the key was present else 0).
+Read opcodes: HM_GET=1 (args k → resp value, or -1 when absent — the
+encoding of the reference's `Option<u64>` response).
+Keys hash onto the dense table with `k % K` (uniform bench keys are already
+dense; the modulus mirrors a hash).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from node_replication_tpu.ops.encoding import Dispatch
+
+HM_PUT = 1
+HM_REMOVE = 2
+HM_GET = 1
+
+ABSENT = -1
+
+
+def make_hashmap(n_keys: int, prefill_value: int | None = None) -> Dispatch:
+    """Build the hashmap Dispatch over a dense table of `n_keys` slots.
+
+    `prefill_value` pre-populates every key (the reference prefills 2^26
+    entries before measuring, `benches/hashmap.rs:131-139`).
+    """
+
+    def make_state():
+        if prefill_value is None:
+            return {
+                "values": jnp.zeros((n_keys,), jnp.int32),
+                "present": jnp.zeros((n_keys,), jnp.bool_),
+            }
+        return {
+            "values": jnp.full((n_keys,), prefill_value, jnp.int32),
+            "present": jnp.ones((n_keys,), jnp.bool_),
+        }
+
+    def put(state, args):
+        k = args[0] % n_keys
+        return {
+            "values": state["values"].at[k].set(args[1]),
+            "present": state["present"].at[k].set(True),
+        }, jnp.int32(0)
+
+    def remove(state, args):
+        k = args[0] % n_keys
+        was = state["present"][k]
+        return {
+            "values": state["values"].at[k].set(0),
+            "present": state["present"].at[k].set(False),
+        }, was.astype(jnp.int32)
+
+    def get(state, args):
+        k = args[0] % n_keys
+        return jnp.where(
+            state["present"][k], state["values"][k], jnp.int32(ABSENT)
+        )
+
+    return Dispatch(
+        name=f"hashmap{n_keys}",
+        make_state=make_state,
+        write_ops=(put, remove),
+        read_ops=(get,),
+        arg_width=3,
+    )

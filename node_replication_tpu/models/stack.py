@@ -1,0 +1,70 @@
+"""Replicated stack.
+
+The reference's second example/bench workload (`nr/examples/stack.rs`,
+`benches/stack.rs`: push/pop 50/50). State is a fixed-capacity buffer plus a
+top cursor (the reference's `Vec<u32>` grows; fixed shapes require a
+capacity, and overflowing pushes are dropped with resp=-1 so behavior stays
+deterministic and testable).
+
+Write opcodes: ST_PUSH=1 (args v → resp new depth, or -1 when full),
+ST_POP=2 (→ resp popped value, or -1 when empty — `Option<u32>` encoding,
+`nr/examples/stack.rs:46-49`).
+Read opcodes: ST_PEEK=1 (→ top value or -1), ST_LEN=2 (→ depth).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from node_replication_tpu.ops.encoding import Dispatch
+
+ST_PUSH = 1
+ST_POP = 2
+ST_PEEK = 1
+ST_LEN = 2
+
+EMPTY = -1
+
+
+def make_stack(capacity: int) -> Dispatch:
+    def make_state():
+        return {
+            "buf": jnp.zeros((capacity,), jnp.int32),
+            "top": jnp.zeros((), jnp.int32),
+        }
+
+    def push(state, args):
+        top = state["top"]
+        ok = top < capacity
+        idx = jnp.where(ok, top, capacity - 1)
+        buf = jnp.where(
+            ok, state["buf"].at[idx].set(args[0]), state["buf"]
+        )
+        new_top = jnp.where(ok, top + 1, top)
+        return {"buf": buf, "top": new_top}, jnp.where(
+            ok, new_top, jnp.int32(EMPTY)
+        )
+
+    def pop(state, args):
+        top = state["top"]
+        ok = top > 0
+        idx = jnp.where(ok, top - 1, 0)
+        val = jnp.where(ok, state["buf"][idx], jnp.int32(EMPTY))
+        return {"buf": state["buf"], "top": jnp.where(ok, top - 1, top)}, val
+
+    def peek(state, args):
+        top = state["top"]
+        return jnp.where(
+            top > 0, state["buf"][jnp.maximum(top - 1, 0)], jnp.int32(EMPTY)
+        )
+
+    def length(state, args):
+        return state["top"]
+
+    return Dispatch(
+        name=f"stack{capacity}",
+        make_state=make_state,
+        write_ops=(push, pop),
+        read_ops=(peek, length),
+        arg_width=3,
+    )

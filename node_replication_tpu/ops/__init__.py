@@ -1,0 +1,17 @@
+from node_replication_tpu.ops.encoding import (
+    Dispatch,
+    NOOP,
+    apply_read,
+    apply_write,
+    encode_ops,
+)
+from node_replication_tpu.ops.context import Context
+
+__all__ = [
+    "Dispatch",
+    "NOOP",
+    "apply_read",
+    "apply_write",
+    "encode_ops",
+    "Context",
+]

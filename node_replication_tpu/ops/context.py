@@ -1,0 +1,78 @@
+"""Per-thread operation batching: the `Context` equivalent.
+
+The reference gives every thread a fixed 32-slot SPSC ring holding
+`(Option<op>, Option<resp>)` pairs with three cursors (`tail` for the owner's
+enqueues, `comb` for the combiner, `head` for response dequeues), relying on
+x86-TSO for its unsynchronized `Cell`s (`nr/src/context.rs:12`, `32-55`).
+
+On the TPU build the combiner is host-side and lock-step (SURVEY.md §7:
+combiner *election* is meaningless without racing threads), so the Context
+keeps only the batching semantics: a bounded ring of pending ops per logical
+thread, drained whole by the combiner, with responses delivered back in
+enqueue order. `MAX_PENDING_OPS` (32) is preserved as the flat-combining
+batch size per thread (`nr/src/context.rs:12`). A native C++ Context with the
+real three-cursor/atomic layout backs the CPU engine in
+`node_replication_tpu/native/`.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+# Flat-combining batch size per thread (`nr/src/context.rs:12`).
+MAX_PENDING_OPS = 32
+
+
+class ContextFullError(RuntimeError):
+    """Raised instead of the reference's spin-retry when a batch is full
+    (`nr/src/replica.rs:350-351` retries `make_pending` forever)."""
+
+
+class Context:
+    """Bounded pending-op ring for one logical thread.
+
+    `enqueue` mirrors `nr/src/context.rs:88-106` (fails when
+    `tail - head == MAX_PENDING_OPS`), `ops` mirrors the combiner drain
+    (`nr/src/context.rs:135-175`), `enqueue_resps`/`res` mirror response
+    delivery (`nr/src/context.rs:111-131`, `178-194`).
+    """
+
+    __slots__ = ("_pending", "_resps", "_inflight")
+
+    def __init__(self) -> None:
+        self._pending: deque = deque()
+        self._resps: deque = deque()
+        self._inflight = 0
+
+    def enqueue(self, opcode: int, args: tuple) -> bool:
+        """Stage one op; False if the batch is full (caller must combine)."""
+        if len(self._pending) + self._inflight >= MAX_PENDING_OPS:
+            return False
+        self._pending.append((opcode, args))
+        return True
+
+    def ops(self) -> list[tuple[int, tuple]]:
+        """Drain all staged ops to the combiner (marks them in flight)."""
+        out = list(self._pending)
+        self._pending.clear()
+        self._inflight += len(out)
+        return out
+
+    def enqueue_resps(self, resps) -> None:
+        """Deliver combiner responses, in the order `ops()` returned."""
+        n = len(resps)
+        if n > self._inflight:
+            raise ValueError(
+                f"{n} responses for {self._inflight} in-flight ops"
+            )
+        self._inflight -= n
+        self._resps.extend(resps)
+
+    def res(self):
+        """Pop the next response, or None if not yet delivered."""
+        if not self._resps:
+            return None
+        return self._resps.popleft()
+
+    def __len__(self) -> int:
+        return len(self._pending)

@@ -1,0 +1,133 @@
+"""Operation encoding and the `Dispatch` contract.
+
+Replaces the reference's `Dispatch` trait (`nr/src/lib.rs:103-125`): instead
+of associated `ReadOperation` / `WriteOperation` / `Response` types and
+`dispatch(&self)` / `dispatch_mut(&mut self)` methods, an operation here is a
+fixed-width record `(opcode: int32, args: int32[arg_width])` and the data
+structure is described by a `Dispatch` value holding
+
+- `make_state()` — builds the replica state pytree (the reference requires
+  `D: Default`, `nr/examples/stack.rs:30-35`; deterministic init is the
+  recovery model, SURVEY.md §5),
+- `write_ops[i]`  : (state, args) -> (state, resp)   — pure `dispatch_mut`,
+- `read_ops[i]`   : (state, args) -> resp            — pure `dispatch`.
+
+Opcode 0 is reserved as NOOP in both spaces so that padded / masked batch
+slots replay as no-ops (the fixed-shape substitute for the reference's
+`Option<T>` log-entry payloads and `alivef` liveness bits,
+`nr/src/log.rs:51-65`). User write opcodes therefore start at 1.
+
+Everything here is jit-safe: `apply_write` / `apply_read` lower to a single
+`lax.switch`, which XLA compiles to a branch table executed uniformly across
+a vmapped replica axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+# Reserved opcode: replay/padding no-op in both the write and read spaces.
+NOOP = 0
+
+# Responses are a single int32 lane. The reference's responses are
+# word-sized as well (`Response = Option<u64>` style, e.g.
+# `nr/examples/stack.rs:46-49`); "None" is conventionally encoded as -1 by
+# the bundled models.
+RESP_DTYPE = jnp.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class Dispatch:
+    """A replicated data structure: state constructor + pure transitions.
+
+    Hashable (frozen, tuples of functions) so it can be a jit static arg.
+    """
+
+    name: str
+    make_state: Callable[[], PyTree]
+    write_ops: tuple
+    read_ops: tuple
+    arg_width: int = 3
+
+    @property
+    def n_write_ops(self) -> int:
+        return len(self.write_ops)
+
+    @property
+    def n_read_ops(self) -> int:
+        return len(self.read_ops)
+
+    def init_state(self) -> PyTree:
+        return self.make_state()
+
+
+def _noop_write(state: PyTree, args: jax.Array):
+    return state, RESP_DTYPE(0)
+
+
+def _noop_read(state: PyTree, args: jax.Array):
+    return RESP_DTYPE(0)
+
+
+def apply_write(d: Dispatch, state: PyTree, opcode: jax.Array, args: jax.Array):
+    """Apply one encoded write op: the jit-safe `dispatch_mut`.
+
+    Unknown / out-of-range opcodes clamp onto the NOOP branch, mirroring how
+    padded log slots must replay as no-ops.
+    """
+
+    def wrap(f):
+        def g(s, a):
+            s2, r = f(s, a)
+            return s2, RESP_DTYPE(r)
+
+        return g
+
+    branches = (_noop_write,) + tuple(wrap(f) for f in d.write_ops)
+    idx = jnp.clip(opcode, 0, len(branches) - 1)
+    return lax.switch(idx, branches, state, args)
+
+
+def apply_read(d: Dispatch, state: PyTree, opcode: jax.Array, args: jax.Array):
+    """Apply one encoded read op: the jit-safe `dispatch` (never mutates)."""
+
+    def wrap(f):
+        def g(s, a):
+            return RESP_DTYPE(f(s, a))
+
+        return g
+
+    branches = (_noop_read,) + tuple(wrap(f) for f in d.read_ops)
+    idx = jnp.clip(opcode, 0, len(branches) - 1)
+    return lax.switch(idx, branches, state, args)
+
+
+def encode_ops(
+    ops: Sequence[tuple], arg_width: int, pad_to: int | None = None
+) -> tuple[jax.Array, jax.Array, int]:
+    """Encode a host-side list of `(opcode, *args)` tuples into device arrays.
+
+    Returns `(opcodes: int32[B], args: int32[B, arg_width], count)` where
+    slots past `count` are NOOP padding. `pad_to` fixes B (for shape-stable
+    jit entry); defaults to `len(ops)`.
+    """
+    n = len(ops)
+    pad = n if pad_to is None else pad_to
+    if n > pad:
+        raise ValueError(f"{n} ops do not fit in pad_to={pad}")
+    opcodes = [int(o[0]) for o in ops] + [NOOP] * (pad - n)
+    args = [
+        list(o[1:]) + [0] * (arg_width - (len(o) - 1)) for o in ops
+    ] + [[0] * arg_width] * (pad - n)
+    return (
+        jnp.asarray(opcodes, jnp.int32),
+        jnp.asarray(args, jnp.int32).reshape(pad, arg_width),
+        n,
+    )

@@ -1,0 +1,15 @@
+from node_replication_tpu.parallel.mesh import (
+    ReplicaStrategy,
+    make_mesh,
+    place,
+    shard_step,
+)
+from node_replication_tpu.parallel.topology import MachineTopology
+
+__all__ = [
+    "ReplicaStrategy",
+    "make_mesh",
+    "place",
+    "shard_step",
+    "MachineTopology",
+]

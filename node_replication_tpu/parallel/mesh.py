@@ -1,0 +1,122 @@
+"""Mesh sharding: replicas and logs across TPU chips.
+
+The reference scales by placing replicas over the NUMA topology
+(`ReplicaStrategy`, `benches/mkbench.rs:321-362`) and partitioning the op
+stream over logs (`LogStrategy`, `benches/mkbench.rs:364-383`), with the
+shared-memory ring as the communication backend (SURVEY.md §2.6). The TPU
+equivalent (SURVEY.md §2.6 "TPU-native equivalent"):
+
+- mesh axis 'replica' — the fleet of replica states shards across chips
+  (data parallelism of *state*); each chip replays only its shard.
+- mesh axis 'log' — CNR's stacked log axis shards across chips
+  (tensor/expert parallelism of the *op stream*); each chip appends and
+  scans only its logs.
+- the log (single-log case) is *replicated* over the mesh: the append batch
+  is identical on every chip, so XLA keeps one copy per chip updated with
+  zero communication, and replicas gather entries locally — the all-gather
+  of appended spans rides ICI only when the batch itself originates sharded.
+
+No hand-written collectives: shardings are declared with
+`jax.sharding.NamedSharding` on a jitted pure step and GSPMD inserts the
+all-gathers (scaling-book recipe: pick a mesh, annotate, let XLA place
+collectives).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from node_replication_tpu.core.log import LogState
+from node_replication_tpu.core.multilog import MultiLogState
+
+
+class ReplicaStrategy(enum.Enum):
+    """How many replicas and where (`benches/mkbench.rs:321-362`). ONE —
+    one replica on one chip; PER_DEVICE — one replica shard per chip (the
+    'Socket'/NUMA-node analog); PER_CORE — replicas sharded over every core
+    of every chip (the 'L1'/PerThread analog, i.e. the full mesh)."""
+
+    ONE = "one"
+    PER_DEVICE = "per_device"
+    PER_CORE = "per_core"
+
+
+def make_mesh(
+    n_replica_shards: int | None = None,
+    n_log_shards: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ('replica', 'log') mesh. Defaults to all devices on the
+    replica axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    total = len(devices)
+    if n_replica_shards is None:
+        n_replica_shards = total // n_log_shards
+    if n_replica_shards * n_log_shards != total:
+        raise ValueError(
+            f"{n_replica_shards}x{n_log_shards} mesh needs "
+            f"{n_replica_shards * n_log_shards} devices, got {total}"
+        )
+    arr = np.asarray(devices).reshape(n_replica_shards, n_log_shards)
+    return Mesh(arr, ("replica", "log"))
+
+
+def _log_spec_tree(log, mesh: Mesh):
+    """Sharding pytree for a log state. Single-log: fully replicated
+    (identical append on every chip). Multi-log: ring + cursors shard over
+    the 'log' mesh axis on their leading log dimension."""
+    if isinstance(log, MultiLogState):
+        return MultiLogState(
+            opcodes=NamedSharding(mesh, P("log")),
+            args=NamedSharding(mesh, P("log")),
+            head=NamedSharding(mesh, P("log")),
+            tail=NamedSharding(mesh, P("log")),
+            ctail=NamedSharding(mesh, P("log")),
+            ltails=NamedSharding(mesh, P("log", "replica")),
+        )
+    assert isinstance(log, LogState)
+    return LogState(
+        opcodes=NamedSharding(mesh, P()),
+        args=NamedSharding(mesh, P()),
+        head=NamedSharding(mesh, P()),
+        tail=NamedSharding(mesh, P()),
+        ctail=NamedSharding(mesh, P()),
+        ltails=NamedSharding(mesh, P("replica")),
+    )
+
+
+def _states_spec_tree(states, mesh: Mesh):
+    """Replica states shard on the leading (replica) axis."""
+    return jax.tree.map(lambda _: NamedSharding(mesh, P("replica")), states)
+
+
+def place(log, states, mesh: Mesh):
+    """device_put log + states with their canonical shardings."""
+    log = jax.device_put(log, _log_spec_tree(log, mesh))
+    states = jax.device_put(states, _states_spec_tree(states, mesh))
+    return log, states
+
+
+def shard_step(step_fn, mesh: Mesh, log_template, states_template,
+               batch_spec: P | None = None, donate: bool = True):
+    """Jit an (unjitted) `make_step`-style step with mesh shardings.
+
+    Write/read batches are [R, B]-shaped: sharded over 'replica' like the
+    states so each chip generates/answers only its shard's ops; the append
+    concatenation all-gathers them (ICI) into the replicated log.
+    """
+    if batch_spec is None:
+        batch_spec = P("replica")
+    log_s = _log_spec_tree(log_template, mesh)
+    states_s = _states_spec_tree(states_template, mesh)
+    bs = NamedSharding(mesh, batch_spec)
+    return jax.jit(
+        step_fn,
+        in_shardings=(log_s, states_s, bs, bs, bs, bs),
+        out_shardings=(log_s, states_s, bs, bs),
+        donate_argnums=(0, 1) if donate else (),
+    )

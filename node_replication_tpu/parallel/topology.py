@@ -1,0 +1,90 @@
+"""Device topology discovery: the hwloc walk, TPU edition.
+
+The reference discovers PU → core → L1/L2/L3 → socket → NUMA node with
+hwloc2 and allocates threads/replicas over that hierarchy
+(`benches/utils/topology.rs:89-156`, `allocate` at `174-219`). The TPU
+hierarchy is device → host (process) → slice: intra-slice links are ICI,
+cross-slice is DCN. This module walks `jax.devices()` into the same kind of
+queryable topology object, and `allocate()` maps a replica/thread-placement
+strategy onto an ordered device list the mesh builder consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from collections import defaultdict
+
+import jax
+
+
+class ThreadMapping(enum.Enum):
+    """Placement order over devices (`benches/utils/topology.rs:19-50`).
+
+    NONE — jax default order; SEQUENTIAL — fill one host's devices before
+    the next (the "fill socket first" analog, keeps a replica group on one
+    host's ICI domain); INTERLEAVE — round-robin across hosts (the
+    cross-socket analog, spreads load across DCN).
+    """
+
+    NONE = "none"
+    SEQUENTIAL = "sequential"
+    INTERLEAVE = "interleave"
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceInfo:
+    device: object
+    index: int
+    process: int  # host index — the "NUMA node" analog
+    slice_index: int  # TPU slice — the "socket" analog
+
+
+class MachineTopology:
+    """Queryable accelerator topology (`MachineTopology`,
+    `benches/utils/topology.rs:89-156`)."""
+
+    def __init__(self, devices=None):
+        devices = list(devices if devices is not None else jax.devices())
+        self.infos = [
+            DeviceInfo(
+                device=d,
+                index=i,
+                process=getattr(d, "process_index", 0),
+                slice_index=getattr(d, "slice_index", None) or 0,
+            )
+            for i, d in enumerate(devices)
+        ]
+
+    def devices(self):
+        return [i.device for i in self.infos]
+
+    def n_devices(self) -> int:
+        return len(self.infos)
+
+    def n_hosts(self) -> int:
+        return len({i.process for i in self.infos})
+
+    def devices_on_host(self, process: int):
+        return [i.device for i in self.infos if i.process == process]
+
+    def allocate(self, mapping: ThreadMapping, n: int):
+        """Pick `n` devices in placement order
+        (`MachineTopology::allocate`, `benches/utils/topology.rs:174-219`)."""
+        if n > len(self.infos):
+            raise ValueError(f"want {n} devices, have {len(self.infos)}")
+        if mapping in (ThreadMapping.NONE, ThreadMapping.SEQUENTIAL):
+            order = sorted(self.infos, key=lambda i: (i.process, i.index))
+        else:  # INTERLEAVE: round-robin hosts
+            by_host = defaultdict(list)
+            for i in sorted(self.infos, key=lambda i: i.index):
+                by_host[i.process].append(i)
+            order = []
+            hosts = sorted(by_host)
+            k = 0
+            while len(order) < len(self.infos):
+                h = hosts[k % len(hosts)]
+                if by_host[h]:
+                    order.append(by_host[h].pop(0))
+                k += 1
+        return [i.device for i in order[:n]]

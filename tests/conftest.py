@@ -1,0 +1,24 @@
+"""Test env: force an 8-device virtual CPU mesh before jax initializes.
+
+Multi-chip hardware is not available in CI; sharding tests run on
+`--xla_force_host_platform_device_count=8` virtual CPU devices, the
+"multi-node without a cluster" idiom (the reference simulates NUMA nodes
+with pinned OS threads in one process, SURVEY.md §4 idiom 5).
+
+Note: the platform must be forced via `jax.config`, not JAX_PLATFORMS — the
+environment's TPU plugin re-registers itself over the env var at interpreter
+start, and a remote-tunnel TPU would make every host↔device transfer in the
+suite cost ~100ms.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

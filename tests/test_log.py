@@ -1,0 +1,205 @@
+"""Log unit tests, mirroring `nr/src/log.rs:708-1131` one-for-one where the
+concept survives the TPU re-design (SURVEY.md §4). Tests that exist only to
+exercise Rust-specific machinery (`Arc` refcount lifecycles, `alivef` wrap
+parity) have no analog: values here are plain array lanes and liveness is
+positional."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from node_replication_tpu import (
+    LogSpec,
+    log_append,
+    log_exec_all,
+    log_init,
+    log_reset,
+    log_space,
+    is_replica_synced_for_reads,
+    encode_ops,
+)
+from node_replication_tpu.models import make_stack, ST_PUSH, ST_POP
+from node_replication_tpu.core.replica import replicate_state
+
+
+def small_spec(n_replicas=1, cap=64, slack=8):
+    return LogSpec(
+        capacity=cap, n_replicas=n_replicas, arg_width=3, gc_slack=slack
+    )
+
+
+def push_batch(vals, pad_to=None):
+    return encode_ops([(ST_PUSH, v) for v in vals], 3, pad_to=pad_to)
+
+
+class TestConstruction:
+    def test_rounds_to_power_of_two(self):
+        # `nr/src/log.rs:184-196`: sizes round up to a power of two.
+        assert LogSpec(capacity=100, gc_slack=8).capacity == 128
+
+    def test_minimum_is_twice_gc_slack(self):
+        # `nr/src/log.rs` test `test_log_min_size` analog.
+        assert LogSpec(capacity=1, gc_slack=8).capacity == 16
+
+    def test_default_entries_power_of_two(self):
+        spec = LogSpec()
+        assert spec.capacity & (spec.capacity - 1) == 0
+
+    def test_init_state(self):
+        spec = small_spec(n_replicas=3)
+        log = log_init(spec)
+        assert int(log.head) == 0 and int(log.tail) == 0
+        assert int(log.ctail) == 0
+        assert log.ltails.shape == (3,)
+        assert log.opcodes.shape == (spec.capacity,)
+
+
+class TestAppend:
+    def test_append_advances_tail_and_writes_entries(self):
+        spec = small_spec()
+        log = log_init(spec)
+        opc, args, n = push_batch([10, 11, 12])
+        log = log_append(spec, log, opc, args, n)
+        assert int(log.tail) == 3
+        assert list(np.asarray(log.opcodes[:3])) == [ST_PUSH] * 3
+        assert list(np.asarray(log.args[:3, 0])) == [10, 11, 12]
+
+    def test_append_masks_padding(self):
+        spec = small_spec()
+        log = log_init(spec)
+        opc, args, _ = push_batch([7, 8], pad_to=8)
+        log = log_append(spec, log, opc, args, 2)
+        assert int(log.tail) == 2
+        # Padded lanes must not have been written anywhere.
+        assert int(np.asarray(log.opcodes[2:]).sum()) == 0
+
+    def test_append_wraps_physical_slots(self):
+        spec = small_spec(cap=16, slack=4)  # capacity 16
+        d = make_stack(64)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 1)
+        for round_vals in ([*range(10)], [*range(10, 20)], [*range(20, 30)]):
+            opc, args, n = push_batch(round_vals)
+            # replay first so head advances and space exists (help-first).
+            assert int(log_space(spec, log)) >= n
+            log = log_append(spec, log, opc, args, n)
+            log, states, _ = log_exec_all(spec, d, log, states, 10)
+        assert int(log.tail) == 30
+        assert int(log.head) == 30
+        # state saw all 30 pushes in order
+        assert int(states["top"][0]) == 30
+        assert list(np.asarray(states["buf"][0][:30])) == list(range(30))
+
+    def test_space_respects_gc_slack(self):
+        spec = small_spec(cap=64, slack=8)
+        log = log_init(spec)
+        assert int(log_space(spec, log)) == 64 - 8
+        opc, args, n = push_batch(list(range(10)))
+        log = log_append(spec, log, opc, args, n)
+        assert int(log_space(spec, log)) == 64 - 8 - 10
+
+
+class TestExec:
+    def test_exec_replays_into_state_and_advances_ltail(self):
+        spec = small_spec()
+        d = make_stack(32)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 1)
+        opc, args, n = push_batch([5, 6])
+        log = log_append(spec, log, opc, args, n)
+        log, states, resps = log_exec_all(spec, d, log, states, 4)
+        assert int(log.ltails[0]) == 2  # clamped to tail, not 4
+        assert int(states["top"][0]) == 2
+        # push resp = new depth; padded window slots answer 0.
+        assert list(np.asarray(resps[0])) == [1, 2, 0, 0]
+
+    def test_exec_idempotent(self):
+        # `nr/src/log.rs` exec-idempotence analog: a second exec with no new
+        # entries must not re-apply anything.
+        spec = small_spec()
+        d = make_stack(32)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 1)
+        opc, args, n = push_batch([1])
+        log = log_append(spec, log, opc, args, n)
+        log, states, _ = log_exec_all(spec, d, log, states, 8)
+        log, states, _ = log_exec_all(spec, d, log, states, 8)
+        assert int(states["top"][0]) == 1
+
+    def test_divergent_ltails_mask_per_replica(self):
+        # SURVEY.md §7 hard part: replicas at different ltails replay
+        # different spans of the same window in one lock-step call.
+        spec = small_spec(n_replicas=2)
+        d = make_stack(32)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 2)
+        opc, args, n = push_batch([1, 2, 3])
+        log = log_append(spec, log, opc, args, n)
+        # replica 1 starts ahead (simulate: it already executed 2 entries)
+        log = log._replace(ltails=log.ltails.at[1].set(2))
+        states["top"] = states["top"].at[1].set(2)
+        states["buf"] = states["buf"].at[1, 0].set(1)
+        states["buf"] = states["buf"].at[1, 1].set(2)
+        log, states, _ = log_exec_all(spec, d, log, states, 4)
+        assert list(np.asarray(log.ltails)) == [3, 3]
+        assert list(np.asarray(states["top"])) == [3, 3]
+        np.testing.assert_array_equal(
+            np.asarray(states["buf"][0]), np.asarray(states["buf"][1])
+        )
+
+    def test_gc_head_is_min_ltail(self):
+        # `advance_head` = min over ltails (`nr/src/log.rs:536-580`).
+        spec = small_spec(n_replicas=2)
+        d = make_stack(32)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 2)
+        opc, args, n = push_batch([1, 2, 3, 4])
+        log = log_append(spec, log, opc, args, n)
+        log = log._replace(ltails=log.ltails.at[1].set(4))  # 1 is synced
+        log, states, _ = log_exec_all(spec, d, log, states, 2)
+        assert list(np.asarray(log.ltails)) == [2, 4]
+        assert int(log.head) == 2
+
+    def test_ctail_is_max_executed(self):
+        # ctail = fetch_max of executed tails (`nr/src/log.rs:520-523`).
+        spec = small_spec(n_replicas=2)
+        d = make_stack(32)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 2)
+        opc, args, n = push_batch([1, 2, 3])
+        log = log_append(spec, log, opc, args, n)
+        log = log._replace(ltails=log.ltails.at[0].set(1))
+        states["top"] = states["top"].at[0].set(1)
+        log, states, _ = log_exec_all(spec, d, log, states, 2)
+        # replica 0: 1+2=3; replica 1: 0+2=2 → ctail = 3
+        assert int(log.ctail) == 3
+        assert is_replica_synced_for_reads(log, 0, log.ctail)
+        assert not is_replica_synced_for_reads(log, 1, log.ctail)
+
+
+class TestReset:
+    def test_reset_zeroes_everything(self):
+        # `Log::reset` for bench reuse (`nr/src/log.rs:593-611`).
+        spec = small_spec(n_replicas=2)
+        log = log_init(spec)
+        opc, args, n = push_batch([1, 2])
+        log = log_append(spec, log, opc, args, n)
+        log = log_reset(spec, log)
+        assert int(log.tail) == 0 and int(log.head) == 0
+        assert int(np.asarray(log.opcodes).sum()) == 0
+
+
+class TestMixedOps:
+    def test_push_pop_interleave_replays_in_order(self):
+        spec = small_spec()
+        d = make_stack(32)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 1)
+        ops = [(ST_PUSH, 10), (ST_PUSH, 20), (ST_POP,), (ST_PUSH, 30), (ST_POP,)]
+        opc, args, n = encode_ops(ops, 3)
+        log = log_append(spec, log, opc, args, n)
+        log, states, resps = log_exec_all(spec, d, log, states, n)
+        r = list(np.asarray(resps[0]))
+        assert r == [1, 2, 20, 2, 30]
+        assert int(states["top"][0]) == 1
+        assert int(states["buf"][0][0]) == 10

@@ -1,0 +1,229 @@
+"""Replica runtime tests, mirroring `nr/src/replica.rs:598-788` plus the
+stack integration suite (`nr/tests/stack.rs`) idioms: shadow-model
+comparison, `verify()` back door, replica convergence."""
+
+import random
+
+import numpy as np
+import pytest
+
+from node_replication_tpu import (
+    MAX_PENDING_OPS,
+    MAX_THREADS_PER_REPLICA,
+    NodeReplicated,
+)
+from node_replication_tpu.core.replica import LogTooSmallError
+from node_replication_tpu.models import (
+    HM_GET,
+    HM_PUT,
+    HM_REMOVE,
+    ST_PEEK,
+    ST_POP,
+    ST_PUSH,
+    make_hashmap,
+    make_stack,
+)
+from node_replication_tpu.models.stack import ST_LEN
+
+
+def small_nr(d, n_replicas=1, **kw):
+    kw.setdefault("log_entries", 256)
+    kw.setdefault("gc_slack", 16)
+    kw.setdefault("exec_window", 32)
+    return NodeReplicated(d, n_replicas=n_replicas, **kw)
+
+
+class TestRegister:
+    def test_register_hands_out_sequential_tokens(self):
+        # `Replica::register` (`nr/src/replica.rs:279-298`).
+        nr = small_nr(make_stack(32), n_replicas=2)
+        t0 = nr.register(0)
+        t1 = nr.register(0)
+        t2 = nr.register(1)
+        assert (t0.rid, t0.tid) == (0, 0)
+        assert (t1.rid, t1.tid) == (0, 1)
+        assert (t2.rid, t2.tid) == (1, 0)
+
+    def test_register_caps_threads(self):
+        nr = small_nr(make_stack(4))
+        for _ in range(MAX_THREADS_PER_REPLICA):
+            nr.register(0)
+        with pytest.raises(RuntimeError):
+            nr.register(0)
+
+    def test_register_bad_replica(self):
+        nr = small_nr(make_stack(4))
+        with pytest.raises(ValueError):
+            nr.register(5)
+
+
+class TestExecuteMut:
+    def test_execute_mut_returns_response(self):
+        nr = small_nr(make_stack(32))
+        tok = nr.register(0)
+        assert nr.execute_mut((ST_PUSH, 42), tok) == 1  # resp = new depth
+        assert nr.execute_mut((ST_POP,), tok) == 42
+        assert nr.execute_mut((ST_POP,), tok) == -1  # empty → None encoding
+
+    def test_batched_enqueue_then_flush(self):
+        nr = small_nr(make_stack(64))
+        tok = nr.register(0)
+        for v in range(10):
+            nr.enqueue_mut((ST_PUSH, v), tok)
+        nr.flush(0)
+        resps = nr.responses(tok)
+        assert resps == list(range(1, 11))
+
+    def test_context_full_auto_combines(self):
+        # `make_pending` spin-retry when the 32-slot ring fills
+        # (`nr/src/replica.rs:350-351`) → transparent combine here.
+        nr = small_nr(make_stack(256))
+        tok = nr.register(0)
+        for v in range(MAX_PENDING_OPS + 5):
+            nr.enqueue_mut((ST_PUSH, v), tok)
+        nr.flush(0)
+        got = nr.responses(tok)
+        assert len(got) == MAX_PENDING_OPS + 5
+
+    def test_combine_collects_threads_in_order(self):
+        # Combiner drains contexts in thread order
+        # (`nr/src/replica.rs:555-557`): t0's ops linearize before t1's.
+        nr = small_nr(make_stack(64))
+        t0, t1 = nr.register(0), nr.register(0)
+        nr.enqueue_mut((ST_PUSH, 100), t0)
+        nr.enqueue_mut((ST_PUSH, 200), t1)
+        nr.flush(0)
+        nr.verify(lambda s: np.testing.assert_array_equal(
+            s["buf"][:2], [100, 200]
+        ))
+
+    def test_log_too_small_raises(self):
+        nr = small_nr(make_stack(64), log_entries=32, gc_slack=8)
+        tok = nr.register(0)
+        with pytest.raises(LogTooSmallError):
+            for v in range(40):
+                nr.enqueue_mut((ST_PUSH, v), tok)
+            nr.flush(0)
+
+    def test_gc_help_first_allows_many_batches(self):
+        # Appenders replay ("help") before appending when the ring is near
+        # full (`nr/src/log.rs:364-387`): many small batches through a tiny
+        # log must succeed.
+        nr = small_nr(make_stack(512), log_entries=32, gc_slack=8,
+                      exec_window=8)
+        tok = nr.register(0)
+        for v in range(300):
+            nr.execute_mut((ST_PUSH, v), tok)
+        assert nr.execute((ST_LEN,), tok) == 300
+
+
+class TestReadPath:
+    def test_read_your_writes(self):
+        # `execute` waits on ctail then reads locally
+        # (`nr/src/replica.rs:483-497`).
+        nr = small_nr(make_hashmap(64))
+        tok = nr.register(0)
+        nr.execute_mut((HM_PUT, 7, 777), tok)
+        assert nr.execute((HM_GET, 7), tok) == 777
+        assert nr.execute((HM_GET, 8), tok) == -1
+
+    def test_lagging_replica_syncs_before_read(self):
+        # A replica that issued nothing must still observe other replicas'
+        # writes once it reads (read-sync via side-channel appends,
+        # `nr/src/replica.rs:598-788` test idiom).
+        nr = small_nr(make_hashmap(64), n_replicas=2)
+        t0 = nr.register(0)
+        t1 = nr.register(1)
+        nr.execute_mut((HM_PUT, 3, 33), t0)
+        assert nr.execute((HM_GET, 3), t1) == 33
+
+
+class TestSyncVerify:
+    def test_sync_catches_up_all_replicas(self):
+        nr = small_nr(make_stack(64), n_replicas=3)
+        tok = nr.register(0)
+        for v in range(10):
+            nr.enqueue_mut((ST_PUSH, v), tok)
+        nr.flush(0)
+        nr.sync()
+        lt = np.asarray(nr.log.ltails)
+        assert (lt == int(nr.log.tail)).all()
+        assert nr.replicas_equal()
+
+    def test_verify_exposes_state(self):
+        nr = small_nr(make_stack(64))
+        tok = nr.register(0)
+        nr.execute_mut((ST_PUSH, 5), tok)
+        top = nr.verify(lambda s: int(s["top"]))
+        assert top == 1
+
+
+class TestShadowModel:
+    def test_sequential_random_ops_vs_shadow_vec(self):
+        # `sequential_test` (`nr/tests/stack.rs:103-168`): random ops vs a
+        # shadow Vec, checked through the verify() back door.
+        rng = random.Random(12)
+        nr = small_nr(make_stack(512))
+        tok = nr.register(0)
+        shadow = []
+        for _ in range(200):
+            if rng.random() < 0.5:
+                v = rng.randrange(1 << 20)
+                nr.execute_mut((ST_PUSH, v), tok)
+                shadow.append(v)
+            else:
+                got = nr.execute_mut((ST_POP,), tok)
+                want = shadow.pop() if shadow else -1
+                assert got == want
+
+        def check(s):
+            assert int(s["top"]) == len(shadow)
+            np.testing.assert_array_equal(
+                s["buf"][: len(shadow)], np.asarray(shadow, np.int32)
+            )
+
+        nr.verify(check)
+
+    def test_hashmap_vs_shadow_dict(self):
+        rng = random.Random(34)
+        nr = small_nr(make_hashmap(128), n_replicas=2)
+        toks = [nr.register(0), nr.register(1)]
+        shadow = {}
+        for _ in range(200):
+            tok = rng.choice(toks)
+            k = rng.randrange(128)
+            roll = rng.random()
+            if roll < 0.4:
+                v = rng.randrange(1 << 20)
+                nr.execute_mut((HM_PUT, k, v), tok)
+                shadow[k] = v
+            elif roll < 0.5:
+                got = nr.execute_mut((HM_REMOVE, k), tok)
+                assert got == (1 if k in shadow else 0)
+                shadow.pop(k, None)
+            else:
+                got = nr.execute((HM_GET, k), tok)
+                assert got == shadow.get(k, -1)
+        nr.sync()
+        assert nr.replicas_equal()
+
+
+class TestConvergence:
+    def test_replicas_are_equal_after_interleaved_writers(self):
+        # `replicas_are_equal` (`nr/tests/stack.rs:434-489`): writers on
+        # both replicas, arbitrary interleaving, identical final state.
+        rng = random.Random(56)
+        nr = small_nr(make_stack(2048), n_replicas=2, exec_window=64)
+        toks = [nr.register(0), nr.register(0), nr.register(1),
+                nr.register(1)]
+        for i in range(400):
+            tok = rng.choice(toks)
+            if rng.random() < 0.6:
+                nr.enqueue_mut((ST_PUSH, i), tok)
+            else:
+                nr.enqueue_mut((ST_POP,), tok)
+            if rng.random() < 0.1:
+                nr.flush(tok.rid)
+        nr.flush()
+        nr.sync()
+        assert nr.replicas_equal()
