@@ -41,7 +41,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from node_replication_tpu.core.log import LogSpec
-from node_replication_tpu.ops.encoding import Dispatch, NOOP, apply_write
+from node_replication_tpu.ops.encoding import (
+    Dispatch,
+    NOOP,
+    apply_write,
+    dispatch_reads,
+)
 
 PyTree = Any
 
@@ -225,6 +230,49 @@ def is_log_synced_for_reads(
 ) -> jax.Array:
     """Reads sync only their mapped log (`cnr/src/replica.rs:599-617`)."""
     return ml.ltails[log_idx, ridx] >= ctail
+
+
+def make_multilog_step(
+    dispatch: Dispatch,
+    spec: MultiLogSpec,
+    writes_per_log: int,
+    reads_per_replica: int,
+    state_partition: Callable | None = None,
+    jit: bool = True,
+    donate: bool = True,
+):
+    """Fused CNR step: per-log append → per-log replay → reads.
+
+    The batch is already LogMapper-partitioned (see `partition_ops`):
+    `wr_opcodes int32[L, B]`, `wr_args int32[L, B, A]`, `counts int64[L]`.
+    Each log appends its bucket and every replica replays every log's new
+    span — the lock-step analog of L parallel combiners
+    (`cnr/src/replica.rs:673-720`). Reads run after replay against local
+    replica state (per-log read sync holds trivially).
+
+    Returns `(ml, states, wr_resps int32[L, R, B], rd_resps int32[R, Br])`.
+    Precondition: all replicas synced on all logs at entry (true by
+    induction when driven step-after-step).
+    """
+    B = int(writes_per_log)
+    Br = int(reads_per_replica)
+    max_batch = spec.capacity - spec.gc_slack
+    if B > max_batch:
+        raise ValueError(
+            f"per-log batch {B} exceeds appendable capacity {max_batch}"
+        )
+
+    def step(ml, states, wr_opcodes, wr_args, counts, rd_opcodes, rd_args):
+        ml = multilog_append(spec, ml, wr_opcodes, wr_args, counts)
+        ml, states, wr_resps = multilog_exec_all(
+            spec, dispatch, ml, states, B, state_partition=state_partition
+        )
+        rd_resps = dispatch_reads(dispatch, states, rd_opcodes, rd_args)
+        return ml, states, wr_resps, rd_resps
+
+    if jit:
+        step = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    return step
 
 
 def partition_ops(

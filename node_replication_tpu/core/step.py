@@ -34,7 +34,7 @@ from node_replication_tpu.core.log import (
     log_append,
     log_exec_all,
 )
-from node_replication_tpu.ops.encoding import Dispatch, apply_read
+from node_replication_tpu.ops.encoding import Dispatch, dispatch_reads
 
 
 def make_step(
@@ -87,11 +87,7 @@ def make_step(
         )[None, :]
         wr_resps = jnp.take_along_axis(resps, own, axis=1)
         # 4. per-replica read batches against post-replay local state.
-        rd_resps = jax.vmap(
-            lambda state, opcs, args: jax.vmap(
-                lambda o, a: apply_read(dispatch, state, o, a)
-            )(opcs, args)
-        )(states, rd_opcodes, rd_args)
+        rd_resps = dispatch_reads(dispatch, states, rd_opcodes, rd_args)
         return log, states, wr_resps, rd_resps
 
     if jit:

@@ -10,6 +10,11 @@ from node_replication_tpu.models.stack import (
     ST_PUSH,
     make_stack,
 )
+from node_replication_tpu.models.synthetic import (
+    SYN_READ,
+    SYN_WRITE,
+    make_synthetic,
+)
 
 __all__ = [
     "HM_GET",
@@ -20,4 +25,7 @@ __all__ = [
     "ST_POP",
     "ST_PUSH",
     "make_stack",
+    "SYN_READ",
+    "SYN_WRITE",
+    "make_synthetic",
 ]

@@ -1,0 +1,80 @@
+"""Synthetic tunable-cost data structure.
+
+The reference's `AbstractDataStructure` models per-op cache-line footprint:
+`n` lines of state, each op touching `cold_reads/cold_writes` random lines
+and `hot_reads/hot_writes` lines from a small hot set
+(`benches/synthetic.rs:59-110`; defaults 200k/20/5/2/1 at `:75-79`). It
+exists to sweep op cost × replica count.
+
+TPU-first: state is `lines: int32[n]`; an op's "random lines" derive
+deterministically from its args via a splitmix-style hash (replay must be
+deterministic on every replica), and touches become fixed-count gathers
+(reads fold into a checksum) and scatters (writes). Costs are Dispatch
+construction parameters so the harness sweeps op cost exactly like the
+reference bench.
+
+Write opcode SYN_WRITE=1 (args seed → resp checksum of read lines);
+read opcode SYN_READ=1 (same footprint, no mutation).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from node_replication_tpu.ops.encoding import Dispatch
+
+SYN_WRITE = 1
+SYN_READ = 1
+
+
+def _mix(x):
+    # splitmix32-style avalanche; deterministic across replicas/devices.
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def _lines(seed, count, n, salt):
+    i = jnp.arange(count, dtype=jnp.uint32)
+    return (_mix(seed.astype(jnp.uint32) + salt * jnp.uint32(0x9E3779B9) + i)
+            % jnp.uint32(n)).astype(jnp.int32)
+
+
+def make_synthetic(
+    n: int = 200_000,
+    cold_reads: int = 20,
+    cold_writes: int = 5,
+    hot_reads: int = 2,
+    hot_writes: int = 1,
+    hot_set: int = 1024,
+) -> Dispatch:
+    hot_set = min(hot_set, n)
+
+    def make_state():
+        return {"lines": jnp.zeros((n,), jnp.int32)}
+
+    def footprint(state, seed):
+        cr = _lines(seed, cold_reads, n, jnp.uint32(1))
+        hr = _lines(seed, hot_reads, hot_set, jnp.uint32(2))
+        idx = jnp.concatenate([cr, hr]) if hot_reads else cr
+        return state["lines"][idx].sum()
+
+    def write(state, args):
+        seed = args[0]
+        checksum = footprint(state, seed)
+        cw = _lines(seed, cold_writes, n, jnp.uint32(3))
+        hw = _lines(seed, hot_writes, hot_set, jnp.uint32(4))
+        idx = jnp.concatenate([cw, hw]) if hot_writes else cw
+        lines = state["lines"].at[idx].add(seed + checksum)
+        return {"lines": lines}, checksum
+
+    def read(state, args):
+        return footprint(state, args[0])
+
+    return Dispatch(
+        name=f"synthetic{n}",
+        make_state=make_state,
+        write_ops=(write,),
+        read_ops=(read,),
+        arg_width=3,
+    )

@@ -109,6 +109,18 @@ def apply_read(d: Dispatch, state: PyTree, opcode: jax.Array, args: jax.Array):
     return lax.switch(idx, branches, state, args)
 
 
+def dispatch_reads(d: Dispatch, states: PyTree, rd_opcodes, rd_args):
+    """Answer per-replica read batches against local replica state:
+    `rd_opcodes int32[R, Br]`, `rd_args int32[R, Br, A]` → `int32[R, Br]`.
+    The batched read path shared by the single- and multi-log steps
+    (`nr/src/replica.rs:483-497` local dispatch, vectorized)."""
+    return jax.vmap(
+        lambda state, opcs, args: jax.vmap(
+            lambda o, a: apply_read(d, state, o, a)
+        )(opcs, args)
+    )(states, rd_opcodes, rd_args)
+
+
 def encode_ops(
     ops: Sequence[tuple], arg_width: int, pad_to: int | None = None
 ) -> tuple[jax.Array, jax.Array, int]:

@@ -1,0 +1,84 @@
+"""Mesh sharding tests on the 8-device virtual CPU mesh (the reference's
+"multi-node without a cluster" idiom, SURVEY.md §4 idiom 5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from node_replication_tpu import LogSpec, log_init, make_step
+from node_replication_tpu.core.replica import replicate_state
+from node_replication_tpu.models import HM_GET, HM_PUT, make_hashmap
+from node_replication_tpu.parallel import (
+    MachineTopology,
+    make_mesh,
+    place,
+    shard_step,
+)
+from node_replication_tpu.parallel.topology import ThreadMapping
+
+
+@pytest.fixture(scope="module")
+def devices():
+    ds = jax.devices()
+    if len(ds) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return ds
+
+
+class TestTopology:
+    def test_walk_and_allocate(self, devices):
+        topo = MachineTopology(devices)
+        assert topo.n_devices() == len(devices)
+        assert topo.n_hosts() >= 1
+        seq = topo.allocate(ThreadMapping.SEQUENTIAL, 4)
+        inter = topo.allocate(ThreadMapping.INTERLEAVE, 4)
+        assert len(seq) == 4 and len(inter) == 4
+
+    def test_allocate_too_many(self, devices):
+        topo = MachineTopology(devices)
+        with pytest.raises(ValueError):
+            topo.allocate(ThreadMapping.NONE, len(devices) + 1)
+
+
+class TestShardedStep:
+    def test_sharded_matches_single_device(self, devices):
+        R, Bw, Br, K = 16, 2, 2, 64
+        spec = LogSpec(capacity=1 << 10, n_replicas=R, arg_width=3,
+                       gc_slack=32)
+        d = make_hashmap(K)
+        fn = make_step(d, spec, Bw, Br, jit=False)
+
+        rng = np.random.default_rng(3)
+        wr_opc = jnp.full((R, Bw), HM_PUT, jnp.int32)
+        wr_args = jnp.asarray(
+            np.stack(
+                [rng.integers(0, K, (R, Bw)),
+                 rng.integers(0, 99, (R, Bw)),
+                 np.zeros((R, Bw))], axis=-1
+            ).astype(np.int32)
+        )
+        rd_opc = jnp.full((R, Br), HM_GET, jnp.int32)
+        rd_args = jnp.zeros((R, Br, 3), jnp.int32).at[..., 0].set(
+            jnp.asarray(rng.integers(0, K, (R, Br)).astype(np.int32))
+        )
+
+        # single-device reference
+        log1 = log_init(spec)
+        st1 = replicate_state(d.init_state(), R)
+        ref = jax.jit(fn)(log1, st1, wr_opc, wr_args, rd_opc, rd_args)
+
+        # 4x2 (replica x log) mesh
+        mesh = make_mesh(4, 2, devices=devices[:8])
+        log2 = log_init(spec)
+        st2 = replicate_state(d.init_state(), R)
+        log2, st2 = place(log2, st2, mesh)
+        sharded = shard_step(fn, mesh, log2, st2, donate=False)
+        got = sharded(log2, st2, wr_opc, wr_args, rd_opc, rd_args)
+
+        for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_mesh_shape_validation(self, devices):
+        with pytest.raises(ValueError):
+            make_mesh(3, 2, devices=devices[:8])
