@@ -15,6 +15,31 @@ from node_replication_tpu.models.synthetic import (
     SYN_WRITE,
     make_synthetic,
 )
+from node_replication_tpu.models.vspace import (
+    VS_IDENTIFY,
+    VS_MAP,
+    VS_RESOLVED,
+    VS_UNMAP,
+    make_vspace,
+)
+from node_replication_tpu.models.memfs import (
+    FS_READ,
+    FS_READ_LOGGED,
+    FS_SIZE,
+    FS_TRUNCATE,
+    FS_WRITE,
+    make_memfs,
+    memfs_log_mapper,
+)
+from node_replication_tpu.models.sortedset import (
+    SS_CONTAINS,
+    SS_INSERT,
+    SS_RANGE_COUNT,
+    SS_RANK,
+    SS_REMOVE,
+    make_sortedset,
+    sortedset_log_mapper,
+)
 
 __all__ = [
     "HM_GET",
@@ -28,4 +53,23 @@ __all__ = [
     "SYN_READ",
     "SYN_WRITE",
     "make_synthetic",
+    "VS_IDENTIFY",
+    "VS_MAP",
+    "VS_RESOLVED",
+    "VS_UNMAP",
+    "make_vspace",
+    "FS_READ",
+    "FS_READ_LOGGED",
+    "FS_SIZE",
+    "FS_TRUNCATE",
+    "FS_WRITE",
+    "make_memfs",
+    "memfs_log_mapper",
+    "SS_CONTAINS",
+    "SS_INSERT",
+    "SS_RANGE_COUNT",
+    "SS_RANK",
+    "SS_REMOVE",
+    "make_sortedset",
+    "sortedset_log_mapper",
 ]

@@ -1,0 +1,99 @@
+"""Replicated in-memory file system (block store).
+
+The reference replays the btfs in-memory FS through NR, with even reads
+forced through the log as write-ops so all replicas observe access order
+(`benches/memfs.rs:24-86`, `294-322`); the CNR variant (nrfs) partitions by
+file with a per-file LogMapper `fd-1` (`benches/nrfs.rs:25-39`).
+
+TPU-first: a fixed grid of files × blocks, `data: int32[n_files, n_blocks]`
+plus per-file sizes. The per-file LogMapper for the CNR path is exported as
+`memfs_log_mapper` (ops on different files commute; ops on one file share a
+log, exactly the nrfs contract).
+
+Write opcodes:
+  FS_WRITE=1     args (fd, block, val) → write one block, extend size;
+                 resp = new size (blocks), or -1 if fd/block out of range.
+  FS_TRUNCATE=2  args (fd) → resp = old size.
+  FS_READ_LOGGED=3  args (fd, block) → a *read through the log* (the memfs
+                 reads-as-writes idiom); resp = block value, state unchanged.
+Read opcodes:
+  FS_READ=1      args (fd, block) → block value, or -1 out of range.
+  FS_SIZE=2      args (fd) → size in blocks.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from node_replication_tpu.ops.encoding import Dispatch
+
+FS_WRITE = 1
+FS_TRUNCATE = 2
+FS_READ_LOGGED = 3
+FS_READ = 1
+FS_SIZE = 2
+
+
+def memfs_log_mapper(opcode: int, args: tuple) -> int:
+    """Per-file commutativity hash (`benches/nrfs.rs:25-39`: `fd - 1`)."""
+    return args[0]
+
+
+def make_memfs(n_files: int, n_blocks: int) -> Dispatch:
+    def make_state():
+        return {
+            "data": jnp.zeros((n_files, n_blocks), jnp.int32),
+            "size": jnp.zeros((n_files,), jnp.int32),
+        }
+
+    def _ok(fd, block):
+        return (fd >= 0) & (fd < n_files) & (block >= 0) & (block < n_blocks)
+
+    def write(state, args):
+        fd, block, val = args[0], args[1], args[2]
+        ok = _ok(fd, block)
+        fdc = jnp.clip(fd, 0, n_files - 1)
+        blc = jnp.clip(block, 0, n_blocks - 1)
+        data = jnp.where(
+            ok, state["data"].at[fdc, blc].set(val), state["data"]
+        )
+        new_size = jnp.maximum(state["size"][fdc], blc + 1)
+        size = jnp.where(ok, state["size"].at[fdc].set(new_size),
+                         state["size"])
+        return {"data": data, "size": size}, jnp.where(
+            ok, new_size, jnp.int32(-1)
+        )
+
+    def truncate(state, args):
+        fd = jnp.clip(args[0], 0, n_files - 1)
+        old = state["size"][fd]
+        row = jnp.zeros((n_blocks,), jnp.int32)
+        return {
+            "data": state["data"].at[fd].set(row),
+            "size": state["size"].at[fd].set(0),
+        }, old
+
+    def read_logged(state, args):
+        fd = jnp.clip(args[0], 0, n_files - 1)
+        block = jnp.clip(args[1], 0, n_blocks - 1)
+        val = jnp.where(_ok(args[0], args[1]), state["data"][fd, block],
+                        jnp.int32(-1))
+        return state, val
+
+    def read(state, args):
+        fd = jnp.clip(args[0], 0, n_files - 1)
+        block = jnp.clip(args[1], 0, n_blocks - 1)
+        return jnp.where(_ok(args[0], args[1]), state["data"][fd, block],
+                         jnp.int32(-1))
+
+    def size(state, args):
+        fd = jnp.clip(args[0], 0, n_files - 1)
+        return state["size"][fd]
+
+    return Dispatch(
+        name=f"memfs{n_files}x{n_blocks}",
+        make_state=make_state,
+        write_ops=(write, truncate, read_logged),
+        read_ops=(read, size),
+        arg_width=3,
+    )

@@ -1,0 +1,76 @@
+"""Replicated ordered set — the skiplist ("mlnr") workload analog.
+
+The reference's lockfree benches replay a concurrent skiplist through CNR,
+sweeping the number of logs (`benches/lockfree.rs:243-276`). A skiplist is
+a pointer structure chosen for O(log n) ordered ops on a CPU; on TPU the
+same *semantics* over a bounded keyspace are a presence bitmap — membership
+is one gather, and ordered queries (rank/range-count) are masked reductions
+that vectorize across the replica axis. Order-statistic reads cost O(K)
+lanes but run at full VPU width; the dense layout is the TPU-native trade.
+
+`sortedset_log_mapper` partitions by key (`cnr` LogMapper contract: equal
+keys conflict → same log; distinct keys commute).
+
+Write opcodes:
+  SS_INSERT=1  args (k) → resp 1 if newly inserted else 0.
+  SS_REMOVE=2  args (k) → resp 1 if present else 0.
+Read opcodes:
+  SS_CONTAINS=1    args (k) → 0/1.
+  SS_RANGE_COUNT=2 args (lo, hi) → #elements in [lo, hi).
+  SS_RANK=3        args (k) → #elements < k.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from node_replication_tpu.ops.encoding import Dispatch
+
+SS_INSERT = 1
+SS_REMOVE = 2
+SS_CONTAINS = 1
+SS_RANGE_COUNT = 2
+SS_RANK = 3
+
+
+def sortedset_log_mapper(opcode: int, args: tuple) -> int:
+    return args[0]
+
+
+def make_sortedset(n_keys: int) -> Dispatch:
+    def make_state():
+        return {"present": jnp.zeros((n_keys,), jnp.bool_)}
+
+    def insert(state, args):
+        k = args[0] % n_keys
+        was = state["present"][k]
+        return {"present": state["present"].at[k].set(True)}, (
+            ~was
+        ).astype(jnp.int32)
+
+    def remove(state, args):
+        k = args[0] % n_keys
+        was = state["present"][k]
+        return {"present": state["present"].at[k].set(False)}, was.astype(
+            jnp.int32
+        )
+
+    def contains(state, args):
+        return state["present"][args[0] % n_keys].astype(jnp.int32)
+
+    def range_count(state, args):
+        ks = jnp.arange(n_keys, dtype=jnp.int32)
+        mask = (ks >= args[0]) & (ks < args[1]) & state["present"]
+        return jnp.sum(mask).astype(jnp.int32)
+
+    def rank(state, args):
+        ks = jnp.arange(n_keys, dtype=jnp.int32)
+        return jnp.sum((ks < args[0]) & state["present"]).astype(jnp.int32)
+
+    return Dispatch(
+        name=f"sortedset{n_keys}",
+        make_state=make_state,
+        write_ops=(insert, remove),
+        read_ops=(contains, range_count, rank),
+        arg_width=3,
+    )

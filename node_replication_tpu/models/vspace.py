@@ -1,0 +1,102 @@
+"""Replicated virtual address space (page-table workload).
+
+The reference replays a full x86-64 4-level page table (PML4→PDPT→PD→PT)
+through NR with Map / MapDevice / Identify ops — the NrOS use-case
+(`benches/vspace.rs:176-481`, ops at `483-526`).
+
+TPU-first: pointer-chasing radix levels are hostile to fixed-shape compiled
+replay, and the workload's semantics are a partial map vpage→pframe over a
+bounded VA window. State is the flattened last-level table
+`frames: int32[n_pages]` (0 = unmapped; the radix walk is an addressing
+scheme, not semantics). Multi-page maps become one masked iota scatter —
+the fixed-shape equivalent of the reference's per-page PT walk loop.
+
+Write opcodes:
+  VS_MAP=1       args (vpage, pframe, npages) → maps vpage+i ↦ pframe+i for
+                 i < min(npages, max_span); resp = #pages newly mapped.
+  VS_UNMAP=2     args (vpage, npages) → resp = #pages that were mapped.
+Read opcodes:
+  VS_IDENTIFY=1  args (vpage) → pframe, or -1 if unmapped
+                 (`benches/vspace.rs` Identify).
+  VS_RESOLVED=2  args (vpage, npages) → count of mapped pages in range.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from node_replication_tpu.ops.encoding import Dispatch
+
+VS_MAP = 1
+VS_UNMAP = 2
+VS_IDENTIFY = 1
+VS_RESOLVED = 2
+
+UNMAPPED = 0
+
+
+def make_vspace(n_pages: int, max_span: int = 16) -> Dispatch:
+    """`max_span` bounds pages touched per op (fixed scatter width)."""
+
+    def make_state():
+        return {"frames": jnp.zeros((n_pages,), jnp.int32)}
+
+    def _span_idx(vpage, npages):
+        lanes = jnp.arange(max_span, dtype=jnp.int32)
+        n = jnp.clip(npages, 0, max_span)
+        # out-of-range lanes scatter to n_pages → dropped
+        idx = jnp.where(
+            (lanes < n) & (vpage + lanes < n_pages),
+            (vpage + lanes) % n_pages,
+            n_pages,
+        )
+        return idx, lanes, n
+
+    def vmap_(state, args):
+        vpage, pframe, npages = args[0], args[1], args[2]
+        idx, lanes, n = _span_idx(vpage, npages)
+        frames = state["frames"]
+        newly = jnp.sum(
+            jnp.where(idx < n_pages, frames.at[idx].get(mode="fill",
+                                                        fill_value=1)
+                      == UNMAPPED, False)
+        )
+        # pframe 0 is reserved (means unmapped); map to pframe+1 offset is
+        # the caller's concern — we store pframe+lanes as given.
+        frames = frames.at[idx].set(pframe + lanes, mode="drop")
+        return {"frames": frames}, newly.astype(jnp.int32)
+
+    def unmap(state, args):
+        vpage, npages = args[0], args[1]
+        idx, lanes, n = _span_idx(vpage, npages)
+        frames = state["frames"]
+        was = jnp.sum(
+            jnp.where(idx < n_pages, frames.at[idx].get(mode="fill",
+                                                        fill_value=UNMAPPED)
+                      != UNMAPPED, False)
+        )
+        frames = frames.at[idx].set(UNMAPPED, mode="drop")
+        return {"frames": frames}, was.astype(jnp.int32)
+
+    def identify(state, args):
+        vpage = args[0] % n_pages
+        f = state["frames"][vpage]
+        return jnp.where(f == UNMAPPED, jnp.int32(-1), f)
+
+    def resolved(state, args):
+        vpage, npages = args[0], args[1]
+        idx, lanes, n = _span_idx(vpage, npages)
+        return jnp.sum(
+            jnp.where(idx < n_pages,
+                      state["frames"].at[idx].get(
+                          mode="fill", fill_value=UNMAPPED) != UNMAPPED,
+                      False)
+        ).astype(jnp.int32)
+
+    return Dispatch(
+        name=f"vspace{n_pages}",
+        make_state=make_state,
+        write_ops=(vmap_, unmap),
+        read_ops=(identify, resolved),
+        arg_width=3,
+    )

@@ -1,9 +1,35 @@
-"""Model smoke/determinism tests for workloads beyond hashmap/stack."""
+"""Model smoke/determinism tests for workloads beyond hashmap/stack:
+synthetic (`benches/synthetic.rs`), vspace (`benches/vspace.rs`), memfs
+(`benches/memfs.rs` / `benches/nrfs.rs`), sortedset (`benches/lockfree.rs`
+skiplist analog)."""
 
 import numpy as np
 
 from node_replication_tpu import NodeReplicated
-from node_replication_tpu.models import SYN_READ, SYN_WRITE, make_synthetic
+from node_replication_tpu.models import (
+    FS_READ,
+    FS_READ_LOGGED,
+    FS_SIZE,
+    FS_TRUNCATE,
+    FS_WRITE,
+    SS_CONTAINS,
+    SS_INSERT,
+    SS_RANGE_COUNT,
+    SS_RANK,
+    SS_REMOVE,
+    SYN_READ,
+    SYN_WRITE,
+    VS_IDENTIFY,
+    VS_MAP,
+    VS_RESOLVED,
+    VS_UNMAP,
+    make_memfs,
+    make_sortedset,
+    make_synthetic,
+    make_vspace,
+    memfs_log_mapper,
+    sortedset_log_mapper,
+)
 
 
 class TestSynthetic:
@@ -45,3 +71,76 @@ class TestSynthetic:
         tok = nr.register(0)
         nr.execute_mut((SYN_WRITE, 1), tok)
         nr.execute((SYN_READ, 1), tok)
+
+
+class TestVSpace:
+    def test_map_identify_unmap(self):
+        d = make_vspace(256, max_span=8)
+        nr = NodeReplicated(d, n_replicas=2, log_entries=256, gc_slack=16)
+        tok = nr.register(0)
+        # map 4 pages at vpage 10 -> frames 100..103 (pframe>=1 contract)
+        assert nr.execute_mut((VS_MAP, 10, 100, 4), tok) == 4
+        assert nr.execute((VS_IDENTIFY, 10), tok) == 100
+        assert nr.execute((VS_IDENTIFY, 13), tok) == 103
+        assert nr.execute((VS_IDENTIFY, 14), tok) == -1
+        assert nr.execute((VS_RESOLVED, 8, 8), tok) == 4
+        # remap overlapping: only 2 new pages beyond the existing 4
+        assert nr.execute_mut((VS_MAP, 12, 200, 4), tok) == 2
+        assert nr.execute((VS_IDENTIFY, 12), tok) == 200
+        assert nr.execute_mut((VS_UNMAP, 10, 6), tok) == 6
+        assert nr.execute((VS_RESOLVED, 0, 256), tok) == 0
+        nr.sync()
+        assert nr.replicas_equal()
+
+    def test_span_clipped_to_max_and_bounds(self):
+        d = make_vspace(32, max_span=4)
+        nr = NodeReplicated(d, n_replicas=1, log_entries=256, gc_slack=16)
+        tok = nr.register(0)
+        # npages > max_span clips to 4
+        assert nr.execute_mut((VS_MAP, 0, 1, 100), tok) == 4
+        # map crossing the end of the VA window only touches valid pages
+        assert nr.execute_mut((VS_MAP, 30, 50, 4), tok) == 2
+        assert nr.execute((VS_IDENTIFY, 31), tok) == 51
+
+
+class TestMemFS:
+    def test_write_read_truncate(self):
+        d = make_memfs(4, 8)
+        nr = NodeReplicated(d, n_replicas=2, log_entries=256, gc_slack=16)
+        tok = nr.register(0)
+        assert nr.execute_mut((FS_WRITE, 1, 3, 42), tok) == 4  # size=4
+        assert nr.execute((FS_READ, 1, 3), tok) == 42
+        assert nr.execute((FS_SIZE, 1), tok) == 4
+        # logged read (reads-as-writes idiom) returns value, mutates nothing
+        assert nr.execute_mut((FS_READ_LOGGED, 1, 3), tok) == 42
+        assert nr.execute((FS_SIZE, 1), tok) == 4
+        assert nr.execute_mut((FS_TRUNCATE, 1), tok) == 4  # old size
+        assert nr.execute((FS_READ, 1, 3), tok) == 0
+        assert nr.execute((FS_SIZE, 1), tok) == 0
+        # out of range
+        assert nr.execute_mut((FS_WRITE, 9, 0, 1), tok) == -1
+        nr.sync()
+        assert nr.replicas_equal()
+
+    def test_log_mapper_partitions_by_file(self):
+        assert memfs_log_mapper(FS_WRITE, (3, 0, 1)) == 3
+        assert memfs_log_mapper(FS_WRITE, (3, 7, 9)) == 3
+
+
+class TestSortedSet:
+    def test_ordered_queries(self):
+        d = make_sortedset(64)
+        nr = NodeReplicated(d, n_replicas=1, log_entries=256, gc_slack=16)
+        tok = nr.register(0)
+        for k in (5, 10, 20, 40):
+            assert nr.execute_mut((SS_INSERT, k), tok) == 1
+        assert nr.execute_mut((SS_INSERT, 10), tok) == 0  # duplicate
+        assert nr.execute((SS_CONTAINS, 10), tok) == 1
+        assert nr.execute((SS_RANGE_COUNT, 5, 21), tok) == 3
+        assert nr.execute((SS_RANK, 21), tok) == 3
+        assert nr.execute_mut((SS_REMOVE, 10), tok) == 1
+        assert nr.execute_mut((SS_REMOVE, 10), tok) == 0
+        assert nr.execute((SS_RANGE_COUNT, 0, 64), tok) == 3
+
+    def test_log_mapper_by_key(self):
+        assert sortedset_log_mapper(SS_INSERT, (17,)) == 17
