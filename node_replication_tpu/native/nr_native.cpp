@@ -1,0 +1,822 @@
+// Native host-side node-replication engine.
+//
+// The reference's library crates are native Rust built on raw atomics
+// (SURVEY.md §2: log ring `nr/src/log.rs`, flat-combining replica
+// `nr/src/replica.rs`, distributed RwLock `nr/src/rwlock.rs`). This file is
+// the TPU framework's host-side native counterpart: the CPU reference path
+// used for differential testing against the JAX/XLA device path, and the
+// engine behind the hashbench/rwlockbench-style CPU benches.
+//
+// The algorithms are re-designed, not translated:
+//  - Ring liveness uses per-entry monotone sequence numbers (Vyukov-queue
+//    style: cell is live for logical position `pos` iff `seq == pos + 1`)
+//    instead of the reference's wrap-parity `alivef`/`lmasks` bitmatrix
+//    (`nr/src/log.rs:88-131`). Same guarantee, one atomic per cell.
+//  - Flat combining uses publication records (one cache-padded record per
+//    thread with an EMPTY→STAGED→DONE lifecycle) instead of the
+//    reference's three-cursor TSO-dependent SPSC rings
+//    (`nr/src/context.rs:43-54`); records are explicit acquire/release so
+//    the engine is portable off x86.
+//  - Multi-log (CNR) mode keys the combiner lock per (replica, log) and
+//    maps ops to logs with a key hash, mirroring `LogMapper`
+//    (`cnr/src/lib.rs:123-137`) for the key-partitioned models.
+//
+// Exposed as a C ABI consumed by ctypes (node_replication_tpu/native/engine.py).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+#include <thread>
+#include <vector>
+#include <chrono>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+static inline void cpu_relax() { _mm_pause(); }
+#else
+static inline void cpu_relax() { std::this_thread::yield(); }
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------- constants
+
+// Flat-combining batch per publication record (`MAX_PENDING_OPS`,
+// `nr/src/context.rs:12`).
+static const int kMaxBatch = 32;
+// Threads per replica (`MAX_THREADS_PER_REPLICA`, `nr/src/replica.rs:56`).
+static const int kMaxThreads = 256;
+// Max replicas registered on one log (`MAX_REPLICAS`, `nr/src/log.rs:26`).
+static const int kMaxReplicas = 192;
+// Fixed op arg width (matches ops/encoding.py arg_width<=4).
+static const int kArgW = 4;
+// GC slack the appender preserves (`GC_FROM_HEAD`, `nr/src/log.rs:36`).
+static const uint64_t kGcSlack = 8192;
+// Spin-diagnostic threshold (`WARN_THRESHOLD`, `nr/src/log.rs:43`), scaled
+// down: after this many fruitless spins the stuck counter increments.
+static const uint64_t kWarnSpins = 1u << 24;
+
+// ------------------------------------------------------------- cache pad
+
+struct alignas(64) PaddedAtomicU64 {
+  std::atomic<uint64_t> v{0};
+};
+struct alignas(64) PaddedAtomicU32 {
+  std::atomic<uint32_t> v{0};
+};
+
+// --------------------------------------------------------- distributed lock
+
+// Reader-favoring distributed reader-writer lock: one writer flag plus one
+// cache-line-padded reader count per reader slot, so read acquisition never
+// bounces a shared line (the capability of `nr/src/rwlock.rs:18-42`).
+struct NrRwLock {
+  std::atomic<uint32_t> wlock{0};
+  int n_slots;
+  PaddedAtomicU32 *readers;
+};
+
+NrRwLock *nr_rwlock_create(int n_slots) {
+  auto *l = new NrRwLock();
+  l->n_slots = n_slots;
+  l->readers = new PaddedAtomicU32[n_slots]();
+  return l;
+}
+
+void nr_rwlock_destroy(NrRwLock *l) {
+  delete[] l->readers;
+  delete l;
+}
+
+void nr_rwlock_read_acquire(NrRwLock *l, int slot) {
+  for (;;) {
+    while (l->wlock.load(std::memory_order_relaxed)) cpu_relax();
+    l->readers[slot].v.fetch_add(1, std::memory_order_acq_rel);
+    if (!l->wlock.load(std::memory_order_acquire)) return;
+    // Writer raced in: back off and retry.
+    l->readers[slot].v.fetch_sub(1, std::memory_order_acq_rel);
+  }
+}
+
+void nr_rwlock_read_release(NrRwLock *l, int slot) {
+  l->readers[slot].v.fetch_sub(1, std::memory_order_release);
+}
+
+void nr_rwlock_write_acquire(NrRwLock *l) {
+  uint32_t expect = 0;
+  while (!l->wlock.compare_exchange_weak(expect, 1,
+                                         std::memory_order_acq_rel,
+                                         std::memory_order_relaxed)) {
+    expect = 0;
+    cpu_relax();
+  }
+  for (int i = 0; i < l->n_slots; i++)
+    while (l->readers[i].v.load(std::memory_order_acquire)) cpu_relax();
+}
+
+void nr_rwlock_write_release(NrRwLock *l) {
+  l->wlock.store(0, std::memory_order_release);
+}
+
+// ------------------------------------------------------------------ models
+
+// A model is the native `Dispatch` impl (`nr/src/lib.rs:103-125` contract):
+// opaque state + pure-ish transition functions returning an int32 response.
+// Semantics intentionally match the JAX models bit-for-bit so differential
+// tests can drive both from one op stream.
+struct Model {
+  void *(*create)(int64_t param);
+  void (*destroy)(void *);
+  int32_t (*dispatch_mut)(void *, int32_t opcode, const int32_t *args);
+  int32_t (*dispatch_rd)(void *, int32_t opcode, const int32_t *args);
+  int64_t (*state_words)(void *);
+  void (*state_dump)(void *, int32_t *out);
+  int concurrent_ok;  // safe for CNR-mode concurrent dispatch on disjoint keys
+};
+
+// --- model 1: dense hashmap (mirrors models/hashmap.py: HM_PUT=1 k,v;
+// HM_REMOVE=2 k; read HM_GET=1 k → value or -1).
+//
+// Each key is one atomic 64-bit cell packing (present << 32 | value):
+// CNR-mode reads run lock-free concurrently with the per-log combiners'
+// dispatch_mut, so per-key state must be observable atomically — a split
+// values/present pair could expose present=1 with a torn value.
+struct HashmapState {
+  int64_t n_keys;
+  std::atomic<uint64_t> *cells;
+};
+
+static const uint64_t kHmPresent = 1ull << 32;
+
+static void *hm_create(int64_t n_keys) {
+  auto *s = new HashmapState();
+  s->n_keys = n_keys;
+  s->cells = new std::atomic<uint64_t>[n_keys]();
+  return s;
+}
+static void hm_destroy(void *p) {
+  auto *s = static_cast<HashmapState *>(p);
+  delete[] s->cells;
+  delete s;
+}
+static int32_t hm_mut(void *p, int32_t opcode, const int32_t *args) {
+  auto *s = static_cast<HashmapState *>(p);
+  int64_t k = ((int64_t)args[0] % s->n_keys + s->n_keys) % s->n_keys;
+  if (opcode == 1) {  // put
+    s->cells[k].store(kHmPresent | (uint32_t)args[1],
+                      std::memory_order_release);
+    return 0;
+  }
+  if (opcode == 2) {  // remove
+    uint64_t old = s->cells[k].exchange(0, std::memory_order_acq_rel);
+    return (old & kHmPresent) ? 1 : 0;
+  }
+  return 0;  // NOOP
+}
+static int32_t hm_rd(void *p, int32_t opcode, const int32_t *args) {
+  auto *s = static_cast<HashmapState *>(p);
+  int64_t k = ((int64_t)args[0] % s->n_keys + s->n_keys) % s->n_keys;
+  if (opcode == 1) {
+    uint64_t c = s->cells[k].load(std::memory_order_acquire);
+    return (c & kHmPresent) ? (int32_t)(uint32_t)c : -1;
+  }
+  return 0;
+}
+static int64_t hm_words(void *p) {
+  return 2 * static_cast<HashmapState *>(p)->n_keys;
+}
+static void hm_dump(void *p, int32_t *out) {
+  auto *s = static_cast<HashmapState *>(p);
+  for (int64_t i = 0; i < s->n_keys; i++) {
+    uint64_t c = s->cells[i].load(std::memory_order_acquire);
+    out[i] = (int32_t)(uint32_t)c;
+    out[s->n_keys + i] = (c & kHmPresent) ? 1 : 0;
+  }
+}
+
+// --- model 2: bounded stack (mirrors models/stack.py: ST_PUSH=1 v →
+// depth or -1; ST_POP=2 → value or -1; reads ST_PEEK=1, ST_LEN=2).
+struct StackState {
+  int64_t capacity;
+  int32_t top;
+  int32_t *buf;
+};
+
+static void *st_create(int64_t capacity) {
+  auto *s = new StackState();
+  s->capacity = capacity;
+  s->top = 0;
+  s->buf = static_cast<int32_t *>(calloc(capacity, sizeof(int32_t)));
+  return s;
+}
+static void st_destroy(void *p) {
+  auto *s = static_cast<StackState *>(p);
+  free(s->buf);
+  delete s;
+}
+static int32_t st_mut(void *p, int32_t opcode, const int32_t *args) {
+  auto *s = static_cast<StackState *>(p);
+  if (opcode == 1) {  // push
+    if (s->top >= s->capacity) return -1;
+    s->buf[s->top++] = args[0];
+    return s->top;
+  }
+  if (opcode == 2) {  // pop
+    if (s->top == 0) return -1;
+    return s->buf[--s->top];
+  }
+  return 0;
+}
+static int32_t st_rd(void *p, int32_t opcode, const int32_t *args) {
+  auto *s = static_cast<StackState *>(p);
+  if (opcode == 1) return s->top > 0 ? s->buf[s->top - 1] : -1;
+  if (opcode == 2) return s->top;
+  return 0;
+}
+static int64_t st_words(void *p) {
+  return 1 + static_cast<StackState *>(p)->capacity;
+}
+static void st_dump(void *p, int32_t *out) {
+  auto *s = static_cast<StackState *>(p);
+  out[0] = s->top;
+  for (int64_t i = 0; i < s->capacity; i++) out[1 + i] = s->buf[i];
+}
+
+static const Model kModels[] = {
+    {nullptr, nullptr, nullptr, nullptr, nullptr, nullptr, 0},  // 0 unused
+    {hm_create, hm_destroy, hm_mut, hm_rd, hm_words, hm_dump, 1},
+    {st_create, st_destroy, st_mut, st_rd, st_words, st_dump, 0},
+};
+static const int kNumModels = 3;
+
+// ------------------------------------------------------------------- log
+
+// One MPMC ring. Liveness: cell at physical slot `pos & mask` is published
+// for logical position `pos` when `seq == pos + 1`. Producers CAS-reserve
+// `[tail, tail+n)`; head only advances to min(ltails) (GC,
+// `nr/src/log.rs:536-580` capability).
+struct alignas(64) Entry {
+  std::atomic<uint64_t> seq;
+  int32_t opcode;
+  uint32_t rid;
+  int32_t args[kArgW];
+};
+
+struct Log {
+  uint64_t capacity;
+  uint64_t mask;
+  Entry *ring;
+  alignas(64) std::atomic<uint64_t> tail{0};
+  alignas(64) std::atomic<uint64_t> head{0};
+  alignas(64) std::atomic<uint64_t> ctail{0};
+  PaddedAtomicU64 *ltails;  // one per replica
+  int n_replicas;
+
+  void init(uint64_t cap, int n_reps) {
+    capacity = 1;
+    while (capacity < cap) capacity <<= 1;
+    mask = capacity - 1;
+    ring = static_cast<Entry *>(
+        aligned_alloc(64, capacity * sizeof(Entry)));
+    for (uint64_t i = 0; i < capacity; i++) {
+      new (&ring[i]) Entry();
+      // Cell i is first written for logical position i; seq==i means
+      // "awaiting lap-0 publication".
+      ring[i].seq.store(i, std::memory_order_relaxed);
+    }
+    n_replicas = n_reps;
+    ltails = new PaddedAtomicU64[n_reps]();
+  }
+  void destroy() {
+    free(ring);
+    delete[] ltails;
+  }
+  uint64_t min_ltail() const {
+    uint64_t m = UINT64_MAX;
+    for (int r = 0; r < n_replicas; r++) {
+      uint64_t v = ltails[r].v.load(std::memory_order_acquire);
+      if (v < m) m = v;
+    }
+    return m;
+  }
+};
+
+// ------------------------------------------------------------------ engine
+
+// Publication record: one per (replica, thread). EMPTY → STAGED (owner
+// publishes a batch) → DONE (combiner delivered responses) → EMPTY.
+enum RecState : uint32_t { REC_EMPTY = 0, REC_STAGED = 1, REC_DONE = 2 };
+
+struct alignas(64) PubRecord {
+  std::atomic<uint32_t> state{REC_EMPTY};
+  int32_t count{0};
+  int32_t log_idx{0};
+  int32_t opcodes[kMaxBatch];
+  int32_t args[kMaxBatch][kArgW];
+  int32_t resps[kMaxBatch];
+};
+
+struct Replica {
+  void *data;
+  NrRwLock *rwlock;                 // guards data in single-log mode
+  std::atomic<uint32_t> *combiner;  // one lock per log
+  PubRecord *records;               // kMaxThreads records
+  std::atomic<int32_t> n_threads{0};
+};
+
+struct Engine {
+  const Model *model;
+  int model_id;
+  int n_replicas;
+  int nlogs;
+  Log *logs;          // nlogs (atomics: not vector-movable)
+  Replica *replicas;  // n_replicas
+  std::atomic<uint64_t> stuck_events{0};  // GC-starvation counter (the
+  // CNR gc-callback analog, `cnr/src/log.rs:135-142`)
+  std::atomic<uint64_t> warn_events{0};
+};
+
+Engine *nr_engine_create(int model_id, int64_t model_param, int n_replicas,
+                         uint64_t log_capacity, int nlogs) {
+  if (model_id <= 0 || model_id >= kNumModels) return nullptr;
+  if (n_replicas < 1 || n_replicas > kMaxReplicas) return nullptr;
+  const Model *m = &kModels[model_id];
+  if (nlogs > 1 && !m->concurrent_ok) return nullptr;
+  auto *e = new Engine();
+  e->model = m;
+  e->model_id = model_id;
+  e->n_replicas = n_replicas;
+  e->nlogs = nlogs < 1 ? 1 : nlogs;
+  e->logs = new Log[e->nlogs]();
+  for (int i = 0; i < e->nlogs; i++) e->logs[i].init(log_capacity, n_replicas);
+  e->replicas = new Replica[n_replicas]();
+  for (int i = 0; i < n_replicas; i++) {
+    Replica &r = e->replicas[i];
+    r.data = m->create(model_param);
+    r.rwlock = nr_rwlock_create(kMaxThreads);
+    r.combiner = new std::atomic<uint32_t>[e->nlogs]();
+    r.records = new PubRecord[kMaxThreads]();
+  }
+  return e;
+}
+
+void nr_engine_destroy(Engine *e) {
+  for (int i = 0; i < e->n_replicas; i++) {
+    Replica &r = e->replicas[i];
+    e->model->destroy(r.data);
+    nr_rwlock_destroy(r.rwlock);
+    delete[] r.combiner;
+    delete[] r.records;
+  }
+  for (int i = 0; i < e->nlogs; i++) e->logs[i].destroy();
+  delete[] e->logs;
+  delete[] e->replicas;
+  delete e;
+}
+
+// Register a thread on replica rid (`Replica::register`,
+// `nr/src/replica.rs:279-298`); returns tid or -1.
+int nr_register(Engine *e, int rid) {
+  if (rid < 0 || rid >= e->n_replicas) return -1;
+  int tid = e->replicas[rid].n_threads.fetch_add(1);
+  if (tid >= kMaxThreads) return -1;
+  return tid;
+}
+
+// Replay `[ltails[rid], tail)` of log `li` into replica rid's data.
+// Caller must hold the (rid, li) combiner lock. In single-log mode the
+// data write-lock is taken (readers use the distributed rwlock); in CNR
+// mode dispatch is lock-free by the commutativity contract.
+static void log_exec(Engine *e, int rid, int li) {
+  Log &lg = e->logs[li];
+  Replica &rep = e->replicas[rid];
+  uint64_t t = lg.tail.load(std::memory_order_acquire);
+  uint64_t lt = lg.ltails[rid].v.load(std::memory_order_relaxed);
+  if (lt >= t) return;
+  bool lock_data = e->nlogs == 1;
+  if (lock_data) nr_rwlock_write_acquire(rep.rwlock);
+  for (uint64_t pos = lt; pos < t; pos++) {
+    Entry &cell = lg.ring[pos & lg.mask];
+    uint64_t spins = 0;
+    while (cell.seq.load(std::memory_order_acquire) != pos + 1) {
+      cpu_relax();
+      if (++spins == kWarnSpins) e->warn_events.fetch_add(1);
+    }
+    int32_t resp = e->model->dispatch_mut(rep.data, cell.opcode, cell.args);
+    if (cell.rid == (uint32_t)rid) {
+      // Deliver the response to the issuing record: args[kArgW-1] slot of
+      // the entry carries (tid << 8 | batch_index) routing.
+      uint32_t route = (uint32_t)cell.args[kArgW - 1];
+      int tid = (int)(route >> 8);
+      int slot = (int)(route & 0xff);
+      PubRecord &rec = rep.records[tid];
+      rec.resps[slot] = resp;
+      if (slot == rec.count - 1)
+        rec.state.store(REC_DONE, std::memory_order_release);
+    }
+  }
+  lg.ltails[rid].v.store(t, std::memory_order_release);
+  if (lock_data) nr_rwlock_write_release(rep.rwlock);
+  // ctail = fetch_max(t) (`nr/src/log.rs:520-523`).
+  uint64_t c = lg.ctail.load(std::memory_order_relaxed);
+  while (c < t && !lg.ctail.compare_exchange_weak(c, t)) {
+  }
+}
+
+// Append n ops for replica rid to log li. Caller holds the combiner lock.
+// Helps GC (replays its own replica) when space is short, then counts a
+// stuck event if other replicas still pin the head — the reference's
+// "appenders must help" + starvation-callback semantics
+// (`nr/src/log.rs:364-387`, `cnr/src/log.rs:505-515`).
+static uint64_t log_append(Engine *e, int rid, int li, int n,
+                           const int32_t *opcodes,
+                           const int32_t (*args)[kArgW]) {
+  Log &lg = e->logs[li];
+  uint64_t spins = 0;
+  for (;;) {
+    uint64_t t = lg.tail.load(std::memory_order_relaxed);
+    uint64_t h = lg.head.load(std::memory_order_relaxed);
+    uint64_t slack = lg.capacity > 2 * kGcSlack ? kGcSlack : lg.capacity / 4;
+    if (t + n > h + lg.capacity - slack) {
+      // advance_head = min(ltails) (`nr/src/log.rs:536-580`).
+      uint64_t m = lg.min_ltail();
+      while (h < m && !lg.head.compare_exchange_weak(h, m)) {
+      }
+      if (t + n > m + lg.capacity - slack) {
+        log_exec(e, rid, li);  // help with our own replica
+        if (lg.min_ltail() + lg.capacity < t + n + slack)
+          if (++spins == 4) e->stuck_events.fetch_add(1);
+        cpu_relax();
+        continue;
+      }
+    }
+    if (lg.tail.compare_exchange_weak(t, t + n,
+                                      std::memory_order_acq_rel)) {
+      for (int i = 0; i < n; i++) {
+        uint64_t pos = t + i;
+        Entry &cell = lg.ring[pos & lg.mask];
+        cell.opcode = opcodes[i];
+        cell.rid = (uint32_t)rid;
+        std::memcpy(cell.args, args[i], sizeof(cell.args));
+        cell.seq.store(pos + 1, std::memory_order_release);
+      }
+      return t;
+    }
+  }
+}
+
+// Flat-combining pass for (rid, li): collect STAGED records mapped to this
+// log, append their ops, replay (`Replica::combine`,
+// `nr/src/replica.rs:543-595`; per-log variant `cnr/src/replica.rs:673-720`).
+static void combine(Engine *e, int rid, int li) {
+  Replica &rep = e->replicas[rid];
+  int nt = rep.n_threads.load(std::memory_order_acquire);
+  if (nt > kMaxThreads) nt = kMaxThreads;
+  int32_t opcodes[kMaxBatch * 8];
+  int32_t args[kMaxBatch * 8][kArgW];
+  int n = 0;
+  for (int tid = 0; tid < nt; tid++) {
+    PubRecord &rec = rep.records[tid];
+    if (rec.state.load(std::memory_order_acquire) != REC_STAGED) continue;
+    if (rec.log_idx != li) continue;
+    if (n + rec.count > kMaxBatch * 8) break;
+    for (int j = 0; j < rec.count; j++) {
+      opcodes[n] = rec.opcodes[j];
+      std::memcpy(args[n], rec.args[j], sizeof(args[n]));
+      // Response routing rides the last arg lane (tid<<8 | slot).
+      args[n][kArgW - 1] = (int32_t)(((uint32_t)tid << 8) | (uint32_t)j);
+      n++;
+    }
+    // Mark collected so a second combine pass doesn't re-append it: flip
+    // to a transient state distinguishable from STAGED. We reuse EMPTY —
+    // the owner only resets from DONE, so EMPTY here is unambiguous.
+    rec.state.store(REC_EMPTY, std::memory_order_relaxed);
+  }
+  if (n > 0) log_append(e, rid, li, n, opcodes, args);
+  log_exec(e, rid, li);
+}
+
+static bool try_combine(Engine *e, int rid, int li) {
+  Replica &rep = e->replicas[rid];
+  uint32_t expect = 0;
+  if (!rep.combiner[li].compare_exchange_strong(
+          expect, 1, std::memory_order_acq_rel, std::memory_order_relaxed))
+    return false;
+  combine(e, rid, li);
+  rep.combiner[li].store(0, std::memory_order_release);
+  return true;
+}
+
+static inline int map_log(Engine *e, const int32_t *args) {
+  // Native LogMapper: key-partitioned (`hash % nlogs`,
+  // `cnr/src/replica.rs:435`). args[0] is the key lane for both models.
+  if (e->nlogs == 1) return 0;
+  return (int)(((uint32_t)args[0]) % (uint32_t)e->nlogs);
+}
+
+// Batched write path: stage up to kMaxBatch ops and wait for responses
+// (`Replica::execute_mut`, `nr/src/replica.rs:345-356`, batch form).
+int nr_execute_mut_batch(Engine *e, int rid, int tid, int n,
+                         const int32_t *opcodes, const int32_t *args_flat,
+                         int32_t *resps_out) {
+  if (n < 1 || n > kMaxBatch) return -1;
+  Replica &rep = e->replicas[rid];
+  PubRecord &rec = rep.records[tid];
+  int li = map_log(e, args_flat);
+  rec.count = n;
+  rec.log_idx = li;
+  for (int j = 0; j < n; j++) {
+    rec.opcodes[j] = opcodes[j];
+    const int32_t *a = args_flat + j * (kArgW - 1);
+    rec.args[j][0] = a[0];
+    rec.args[j][1] = a[1];
+    rec.args[j][2] = a[2];
+    rec.args[j][kArgW - 1] = 0;
+    if (e->nlogs > 1 && map_log(e, rec.args[j]) != li) return -2;
+  }
+  rec.state.store(REC_STAGED, std::memory_order_release);
+  uint64_t spins = 0;
+  while (rec.state.load(std::memory_order_acquire) != REC_DONE) {
+    if (!try_combine(e, rid, li)) cpu_relax();
+    if (rec.state.load(std::memory_order_acquire) == REC_DONE) break;
+    if (++spins == kWarnSpins) e->warn_events.fetch_add(1);
+  }
+  rec.state.store(REC_EMPTY, std::memory_order_relaxed);
+  for (int j = 0; j < n; j++) resps_out[j] = rec.resps[j];
+  return 0;
+}
+
+int32_t nr_execute_mut(Engine *e, int rid, int tid, int32_t opcode,
+                       const int32_t *args) {
+  int32_t resp;
+  nr_execute_mut_batch(e, rid, tid, 1, &opcode, args, &resp);
+  return resp;
+}
+
+// Read path (`read_only`, `nr/src/replica.rs:483-497`): wait until this
+// replica has replayed to the completed tail of the mapped log (helping
+// combine while waiting), then dispatch locally under the read lock.
+int32_t nr_execute(Engine *e, int rid, int tid, int32_t opcode,
+                   const int32_t *args) {
+  int li = map_log(e, args);
+  Log &lg = e->logs[li];
+  Replica &rep = e->replicas[rid];
+  uint64_t c = lg.ctail.load(std::memory_order_acquire);
+  uint64_t spins = 0;
+  while (lg.ltails[rid].v.load(std::memory_order_acquire) < c) {
+    if (!try_combine(e, rid, li)) cpu_relax();
+    if (++spins == kWarnSpins) e->warn_events.fetch_add(1);
+  }
+  int32_t a[kArgW] = {args[0], args[1], args[2], 0};
+  int32_t resp;
+  if (e->nlogs == 1) {
+    nr_rwlock_read_acquire(rep.rwlock, tid);
+    resp = e->model->dispatch_rd(rep.data, opcode, a);
+    nr_rwlock_read_release(rep.rwlock, tid);
+  } else {
+    resp = e->model->dispatch_rd(rep.data, opcode, a);
+  }
+  return resp;
+}
+
+// Catch replica rid up on every log (`Replica::sync`,
+// `nr/src/replica.rs:469-479`; all-logs loop `cnr/src/replica.rs:579-597`).
+void nr_sync(Engine *e, int rid) {
+  for (int li = 0; li < e->nlogs; li++) {
+    for (;;) {
+      Log &lg = e->logs[li];
+      if (lg.ltails[rid].v.load(std::memory_order_acquire) >=
+          lg.tail.load(std::memory_order_acquire))
+        break;
+      if (!try_combine(e, rid, li)) cpu_relax();
+    }
+  }
+}
+
+// Targeted single-log sync (`sync_log`, `cnr/src/replica.rs:579-597`).
+void nr_sync_log(Engine *e, int rid, int li) {
+  for (;;) {
+    Log &lg = e->logs[li];
+    if (lg.ltails[rid].v.load(std::memory_order_acquire) >=
+        lg.tail.load(std::memory_order_acquire))
+      break;
+    if (!try_combine(e, rid, li)) cpu_relax();
+  }
+}
+
+// verify() test hook (`Replica::verify`, `nr/src/replica.rs:443-467`):
+// sync, then dump replica state for host-side assertions.
+int64_t nr_state_words(Engine *e) {
+  return e->model->state_words(e->replicas[0].data);
+}
+void nr_state_dump(Engine *e, int rid, int32_t *out) {
+  nr_sync(e, rid);
+  e->model->state_dump(e->replicas[rid].data, out);
+}
+
+uint64_t nr_stuck_events(Engine *e) { return e->stuck_events.load(); }
+uint64_t nr_warn_events(Engine *e) { return e->warn_events.load(); }
+uint64_t nr_log_tail(Engine *e, int li) { return e->logs[li].tail.load(); }
+uint64_t nr_log_head(Engine *e, int li) { return e->logs[li].head.load(); }
+uint64_t nr_log_ctail(Engine *e, int li) { return e->logs[li].ctail.load(); }
+uint64_t nr_log_ltail(Engine *e, int li, int rid) {
+  return e->logs[li].ltails[rid].v.load();
+}
+int nr_max_batch() { return kMaxBatch; }
+
+// -------------------------------------------------------------- bench loops
+
+// Measured in-process so thread loops never cross the FFI per op. A splitmix
+// PRNG picks keys/ops; write ratio in percent. Returns total completed ops;
+// per-thread counts land in out_per_thread (reference prints aggregate +
+// min/max per core, `benches/mkbench.rs:592-604`).
+static inline uint64_t splitmix(uint64_t &x) {
+  x += 0x9e3779b97f4a7c15ull;
+  uint64_t z = x;
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+uint64_t nr_bench_hashmap(Engine *e, int threads_per_replica, int write_pct,
+                          int64_t keyspace, int batch, int duration_ms,
+                          uint64_t seed, uint64_t *out_per_thread) {
+  int total_threads = e->n_replicas * threads_per_replica;
+  std::vector<std::thread> ts;
+  std::vector<uint64_t> counts(total_threads, 0);
+  std::atomic<int> ready{0};
+  std::atomic<bool> go{false}, stop{false};
+  if (batch < 1) batch = 1;
+  if (batch > kMaxBatch) batch = kMaxBatch;
+  for (int g = 0; g < total_threads; g++) {
+    ts.emplace_back([&, g]() {
+      int rid = g % e->n_replicas;
+      int tid = nr_register(e, rid);
+      uint64_t rng = seed + 0x1000 * g + 1;
+      ready.fetch_add(1);
+      if (tid < 0) return;  // registration slots exhausted: sit out
+      while (!go.load(std::memory_order_acquire)) cpu_relax();
+      uint64_t done = 0;
+      int32_t opcodes[kMaxBatch];
+      int32_t args[kMaxBatch][3];
+      int32_t resps[kMaxBatch];
+      while (!stop.load(std::memory_order_relaxed)) {
+        int nw = 0;
+        for (int j = 0; j < batch; j++) {
+          uint64_t r = splitmix(rng);
+          int32_t key = (int32_t)(r % (uint64_t)keyspace);
+          // Op-type decision from the high bits so it stays independent of
+          // the key when gcd(keyspace, 100) > 1.
+          if ((int)((r >> 40) % 100) < write_pct) {
+            opcodes[nw] = 1;  // put
+            args[nw][0] = key;
+            args[nw][1] = (int32_t)(r >> 33);
+            args[nw][2] = 0;
+            nw++;
+          } else {
+            int32_t a[3] = {key, 0, 0};
+            nr_execute(e, rid, tid, 1, a);  // get
+            done++;
+          }
+        }
+        if (nw > 0) {
+          if (e->nlogs == 1) {
+            nr_execute_mut_batch(e, rid, tid, nw, opcodes, &args[0][0],
+                                 resps);
+            done += nw;
+          } else {
+            for (int j = 0; j < nw; j++) {
+              nr_execute_mut(e, rid, tid, opcodes[j], args[j]);
+              done++;
+            }
+          }
+        }
+      }
+      counts[g] = done;
+      // Keep replaying until everyone is done so no replica pins the head
+      // (end-of-run protocol, `benches/mkbench.rs:799-821`).
+      nr_sync(e, rid);
+    });
+  }
+  while (ready.load() != total_threads) cpu_relax();
+  go.store(true, std::memory_order_release);
+  std::this_thread::sleep_for(std::chrono::milliseconds(duration_ms));
+  stop.store(true);
+  for (auto &t : ts) t.join();
+  uint64_t total = 0;
+  for (int g = 0; g < total_threads; g++) {
+    total += counts[g];
+    if (out_per_thread) out_per_thread[g] = counts[g];
+  }
+  return total;
+}
+
+// Raw append throughput, no replay (`benches/log.rs:48-79` analog).
+uint64_t nr_bench_log_append(uint64_t log_capacity, int n_threads, int batch,
+                             int duration_ms) {
+  Log lg;
+  lg.init(log_capacity, 1);
+  // Keep the single replica's ltail pinned to tail so GC never blocks
+  // (the reference disables GC by resetting, `benches/log.rs:60-66`):
+  // mark it caught-up from a chaser thread.
+  std::atomic<bool> stop{false};
+  std::thread chaser([&]() {
+    while (!stop.load(std::memory_order_relaxed)) {
+      uint64_t t = lg.tail.load(std::memory_order_acquire);
+      lg.ltails[0].v.store(t, std::memory_order_release);
+      std::this_thread::yield();
+    }
+  });
+  std::vector<std::thread> ts;
+  std::vector<uint64_t> counts(n_threads, 0);
+  std::atomic<bool> go{false};
+  if (batch < 1) batch = 1;
+  for (int g = 0; g < n_threads; g++) {
+    ts.emplace_back([&, g]() {
+      while (!go.load(std::memory_order_acquire)) cpu_relax();
+      uint64_t done = 0;
+      while (!stop.load(std::memory_order_relaxed)) {
+        for (;;) {
+          uint64_t t = lg.tail.load(std::memory_order_relaxed);
+          uint64_t h = lg.ltails[0].v.load(std::memory_order_relaxed);
+          if (t + batch > h + lg.capacity) {
+            cpu_relax();
+            continue;
+          }
+          if (lg.tail.compare_exchange_weak(t, t + batch)) {
+            for (int i = 0; i < batch; i++) {
+              Entry &cell = lg.ring[(t + i) & lg.mask];
+              cell.opcode = 1;
+              cell.rid = 0;
+              cell.seq.store(t + i + 1, std::memory_order_release);
+            }
+            done += batch;
+            break;
+          }
+        }
+      }
+      counts[g] = done;
+    });
+  }
+  go.store(true, std::memory_order_release);
+  std::this_thread::sleep_for(std::chrono::milliseconds(duration_ms));
+  stop.store(true);
+  for (auto &t : ts) t.join();
+  chaser.join();
+  lg.destroy();
+  uint64_t total = 0;
+  for (auto c : counts) total += c;
+  return total;
+}
+
+// RwLock bench: r readers + w writers hammering one lock for duration_ms
+// (`benches/rwlockbench.rs` analog). Returns ops; writer ops via out_writes.
+uint64_t nr_bench_rwlock(int n_readers, int n_writers, int duration_ms,
+                         uint64_t *out_writes) {
+  NrRwLock *l = nr_rwlock_create(kMaxThreads);
+  std::atomic<bool> go{false}, stop{false};
+  std::vector<std::thread> ts;
+  std::vector<uint64_t> rc(n_readers, 0), wc(n_writers, 0);
+  volatile uint64_t shared = 0;
+  for (int g = 0; g < n_readers; g++) {
+    ts.emplace_back([&, g]() {
+      while (!go.load()) cpu_relax();
+      uint64_t n = 0;
+      while (!stop.load(std::memory_order_relaxed)) {
+        nr_rwlock_read_acquire(l, g);
+        uint64_t v = shared;
+        (void)v;
+        nr_rwlock_read_release(l, g);
+        n++;
+      }
+      rc[g] = n;
+    });
+  }
+  for (int g = 0; g < n_writers; g++) {
+    ts.emplace_back([&, g]() {
+      while (!go.load()) cpu_relax();
+      uint64_t n = 0;
+      while (!stop.load(std::memory_order_relaxed)) {
+        nr_rwlock_write_acquire(l);
+        shared = shared + 1;
+        nr_rwlock_write_release(l);
+        n++;
+      }
+      wc[g] = n;
+    });
+  }
+  go.store(true);
+  std::this_thread::sleep_for(std::chrono::milliseconds(duration_ms));
+  stop.store(true);
+  for (auto &t : ts) t.join();
+  uint64_t reads = 0, writes = 0;
+  for (auto c : rc) reads += c;
+  for (auto c : wc) writes += c;
+  if (out_writes) *out_writes = writes;
+  nr_rwlock_destroy(l);
+  return reads + writes;
+}
+
+}  // extern "C"

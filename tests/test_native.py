@@ -1,0 +1,373 @@
+"""Native C++ engine tests: unit, concurrency, and differential-vs-JAX.
+
+The native engine is the host-side CPU reference path (SURVEY.md §7
+"Native (C++) components"); these tests mirror the reference's module unit
+tests (`nr/src/log.rs:708-1131`, `nr/src/replica.rs:598-788`,
+`nr/src/rwlock.rs:268-550`) and add the differential idiom: one op stream
+driven through both the JAX device path and the native path must produce
+identical responses and identical final state.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from node_replication_tpu.native import (
+    MODEL_HASHMAP,
+    MODEL_STACK,
+    NativeEngine,
+    NativeRwLock,
+)
+from node_replication_tpu.native.engine import bench_log_append, bench_rwlock
+
+
+# ------------------------------------------------------------------ basics
+
+
+class TestEngineBasics:
+    def test_hashmap_semantics(self):
+        with NativeEngine(MODEL_HASHMAP, 64, n_replicas=1) as e:
+            t = e.register(0)
+            assert e.execute((1, 3), t) == -1  # absent
+            assert e.execute_mut((1, 3, 99), t) == 0  # put
+            assert e.execute((1, 3), t) == 99
+            assert e.execute_mut((2, 3), t) == 1  # remove present
+            assert e.execute_mut((2, 3), t) == 0  # remove absent
+            assert e.execute((1, 3), t) == -1
+
+    def test_stack_semantics(self):
+        with NativeEngine(MODEL_STACK, 4, n_replicas=1) as e:
+            t = e.register(0)
+            assert e.execute_mut((2,), t) == -1  # pop empty
+            assert e.execute_mut((1, 10), t) == 1
+            assert e.execute_mut((1, 11), t) == 2
+            assert e.execute((1,), t) == 11  # peek
+            assert e.execute((2,), t) == 2  # len
+            assert e.execute_mut((2,), t) == 11
+            # overflow: capacity 4
+            for v in range(4):
+                e.execute_mut((1, v), t)
+            assert e.execute_mut((1, 99), t) == -1
+
+    def test_register_limits(self):
+        with NativeEngine(MODEL_HASHMAP, 8, n_replicas=2) as e:
+            with pytest.raises(RuntimeError):
+                e.register(5)
+
+    def test_invalid_engine_configs(self):
+        # stack is not concurrent-safe: CNR mode must be rejected
+        with pytest.raises(ValueError):
+            NativeEngine(MODEL_STACK, 8, n_replicas=1, nlogs=2)
+        with pytest.raises(ValueError):
+            NativeEngine(0, 8, n_replicas=1)
+
+    def test_cursor_telemetry(self):
+        with NativeEngine(MODEL_HASHMAP, 16, n_replicas=2) as e:
+            t0 = e.register(0)
+            e.execute_mut_batch([(1, k, k) for k in range(8)], t0)
+            assert e.log_tail() == 8
+            assert e.log_ltail(0, 0) == 8  # own replica replayed
+            assert e.log_ctail() == 8
+            e.sync(1)
+            assert e.log_ltail(0, 1) == 8
+
+    def test_read_your_writes_across_replicas(self):
+        with NativeEngine(MODEL_HASHMAP, 16, n_replicas=2) as e:
+            t0, t1 = e.register(0), e.register(1)
+            e.execute_mut((1, 7, 123), t0)
+            # read on the OTHER replica must observe the ctail'd write
+            assert e.execute((1, 7), t1) == 123
+
+
+class TestLogWrap:
+    def test_wraparound_and_gc(self):
+        # log capacity 1<<8=256, slack=64; push 10 laps of ops through
+        with NativeEngine(
+            MODEL_HASHMAP, 32, n_replicas=1, log_capacity=256
+        ) as e:
+            t = e.register(0)
+            for i in range(2560 // 32):
+                e.execute_mut_batch(
+                    [(1, (i * 32 + j) % 32, i) for j in range(32)], t
+                )
+            assert e.log_tail() == 2560
+            assert e.log_head() > 0  # GC advanced
+            lap = 2560 // 32 - 1
+            assert all(e.state_dump(0)[:32] == lap)
+
+    def test_stuck_counter_fires_on_dormant_replica(self):
+        # Replica 1 never syncs; appender must help-and-wait, bumping the
+        # starvation counter (the CNR gc-callback capability,
+        # `cnr/src/log.rs:505-515`), until replica 1 is synced.
+        e = NativeEngine(MODEL_HASHMAP, 16, n_replicas=2, log_capacity=256)
+        t0 = e.register(0)
+        done = threading.Event()
+
+        def appender():
+            for i in range(300 // 25):
+                e.execute_mut_batch([(1, j % 16, i) for j in range(25)], t0)
+            done.set()
+
+        th = threading.Thread(target=appender, daemon=True)
+        th.start()
+        deadline = time.time() + 30
+        while not done.is_set() and time.time() < deadline:
+            e.sync(1)
+            time.sleep(0.001)
+        assert done.is_set()
+        th.join()
+        assert e.stuck_events() >= 1
+        e.sync()
+        assert e.replicas_equal()
+        e.close()
+
+
+# -------------------------------------------------------------- concurrency
+
+
+class TestConcurrency:
+    def test_threads_converge_and_count(self):
+        R, T, OPS = 2, 4, 400
+        with NativeEngine(
+            MODEL_HASHMAP, 128, n_replicas=R, log_capacity=1 << 12
+        ) as e:
+            errs = []
+
+            def worker(rid, seed):
+                try:
+                    tok = e.register(rid)
+                    rng = random.Random(seed)
+                    for _ in range(OPS):
+                        k = rng.randrange(128)
+                        if rng.random() < 0.7:
+                            e.execute_mut((1, k, rng.randrange(1000)), tok)
+                        else:
+                            e.execute((1, k), tok)
+                except Exception as ex:  # pragma: no cover
+                    errs.append(ex)
+
+            ts = [
+                threading.Thread(target=worker, args=(g % R, g))
+                for g in range(R * T)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs
+            e.sync()
+            assert e.replicas_equal()
+
+    def test_stack_per_thread_order_preserved(self):
+        # The reference's VerifyStack idiom (`nr/tests/stack.rs:236-276`):
+        # tagged pushes (count<<8 | thread) must appear in per-thread
+        # monotone order in the final replayed stack.
+        R, T, OPS = 2, 3, 100
+        with NativeEngine(
+            MODEL_STACK, 4096, n_replicas=R, log_capacity=1 << 12
+        ) as e:
+
+            def worker(rid, g):
+                tok = e.register(rid)
+                for c in range(OPS):
+                    e.execute_mut((1, (c << 8) | g), tok)
+
+            ts = [
+                threading.Thread(target=worker, args=(g % R, g))
+                for g in range(R * T)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            e.sync()
+            assert e.replicas_equal()
+            dump = e.state_dump(0)
+            top, buf = dump[0], dump[1:]
+            assert top == R * T * OPS
+            vals = buf[:top]
+            for g in range(R * T):
+                counts = [v >> 8 for v in vals if (v & 0xFF) == g]
+                assert counts == sorted(counts)
+                assert len(counts) == OPS
+
+    def test_cnr_multilog_concurrent(self):
+        R, T, OPS, L = 2, 4, 300, 4
+        with NativeEngine(
+            MODEL_HASHMAP, 256, n_replicas=R, log_capacity=1 << 12, nlogs=L
+        ) as e:
+
+            def worker(rid, seed):
+                tok = e.register(rid)
+                rng = random.Random(seed)
+                for _ in range(OPS):
+                    k = rng.randrange(256)
+                    e.execute_mut((1, k, rng.randrange(1000)), tok)
+
+            ts = [
+                threading.Thread(target=worker, args=(g % R, g))
+                for g in range(R * T)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            e.sync()
+            assert e.replicas_equal()
+            assert sum(e.log_tail(i) for i in range(L)) == R * T * OPS
+
+
+class TestRwLock:
+    def test_mutual_exclusion(self):
+        # Writers protect a non-atomic critical section with a sleep inside
+        # (GIL released) — lost updates would show without the lock.
+        lock = NativeRwLock(64)
+        shared = [0]
+
+        def writer():
+            for _ in range(50):
+                lock.write_acquire()
+                v = shared[0]
+                time.sleep(0.0002)
+                shared[0] = v + 1
+                lock.write_release()
+
+        ts = [threading.Thread(target=writer) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert shared[0] == 200
+
+    def test_readers_parallel_with_no_writer(self):
+        lock = NativeRwLock(8)
+        inside = []
+        barrier = threading.Barrier(4)
+
+        def reader(slot):
+            lock.read_acquire(slot)
+            barrier.wait(timeout=10)  # all 4 hold the read lock at once
+            inside.append(slot)
+            lock.read_release(slot)
+
+        ts = [threading.Thread(target=reader, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sorted(inside) == [0, 1, 2, 3]
+
+    def test_bench_runs(self):
+        total, writes = bench_rwlock(2, 1, 50)
+        assert total > 0 and writes > 0
+
+
+# -------------------------------------------------------------- differential
+
+
+def _jax_hashmap_dump(nr, rid=0):
+    import jax
+
+    state = jax.tree.map(lambda a: np.asarray(a[rid]), nr.states)
+    return np.concatenate(
+        [state["values"], state["present"].astype(np.int32)]
+    )
+
+
+class TestDifferentialVsJax:
+    """One op stream → JAX device path and native path → identical
+    responses + identical final state."""
+
+    def test_hashmap_differential(self):
+        from node_replication_tpu.core.replica import NodeReplicated
+        from node_replication_tpu.models import make_hashmap
+
+        K, R, N = 32, 2, 300
+        rng = random.Random(42)
+        jx = NodeReplicated(
+            make_hashmap(K), n_replicas=R, log_entries=1 << 10, gc_slack=64
+        )
+        nat = NativeEngine(MODEL_HASHMAP, K, n_replicas=R, log_capacity=1 << 10)
+        jt = [jx.register(r) for r in range(R)]
+        nt = [nat.register(r) for r in range(R)]
+        for i in range(N):
+            r = rng.randrange(R)
+            k = rng.randrange(K)
+            p = rng.random()
+            if p < 0.45:
+                op = (1, k, rng.randrange(10_000))
+                assert jx.execute_mut(op, jt[r]) == nat.execute_mut(op, nt[r])
+            elif p < 0.6:
+                op = (2, k)
+                assert jx.execute_mut(op, jt[r]) == nat.execute_mut(op, nt[r])
+            else:
+                op = (1, k)
+                assert jx.execute(op, jt[r]) == nat.execute(op, nt[r])
+        jx.sync()
+        nat.sync()
+        for r in range(R):
+            np.testing.assert_array_equal(
+                _jax_hashmap_dump(jx, r), nat.state_dump(r)
+            )
+        nat.close()
+
+    def test_stack_differential(self):
+        from node_replication_tpu.core.replica import NodeReplicated
+        from node_replication_tpu.models import make_stack
+
+        CAP, R, N = 64, 2, 250
+        rng = random.Random(7)
+        jx = NodeReplicated(
+            make_stack(CAP), n_replicas=R, log_entries=1 << 10, gc_slack=64
+        )
+        nat = NativeEngine(MODEL_STACK, CAP, n_replicas=R, log_capacity=1 << 10)
+        jt = [jx.register(r) for r in range(R)]
+        nt = [nat.register(r) for r in range(R)]
+        for i in range(N):
+            r = rng.randrange(R)
+            p = rng.random()
+            if p < 0.5:
+                op = (1, rng.randrange(1000))
+                assert jx.execute_mut(op, jt[r]) == nat.execute_mut(op, nt[r])
+            elif p < 0.8:
+                op = (2,)
+                assert jx.execute_mut(op, jt[r]) == nat.execute_mut(op, nt[r])
+            else:
+                op = (1,) if rng.random() < 0.5 else (2,)
+                assert jx.execute(op, jt[r]) == nat.execute(op, nt[r])
+        jx.sync()
+        nat.sync()
+        import jax
+
+        for r in range(R):
+            st = jax.tree.map(lambda a: np.asarray(a[r]), jx.states)
+            dump = nat.state_dump(r)
+            assert dump[0] == st["top"]
+            np.testing.assert_array_equal(
+                dump[1 : 1 + int(st["top"])], st["buf"][: int(st["top"])]
+            )
+        nat.close()
+
+
+class TestBenchEntryPoints:
+    def test_hashmap_bench_smoke(self):
+        with NativeEngine(
+            MODEL_HASHMAP, 1024, n_replicas=2, log_capacity=1 << 14
+        ) as e:
+            total, per = e.bench_hashmap(
+                threads_per_replica=2,
+                write_pct=20,
+                keyspace=1024,
+                duration_ms=100,
+            )
+            assert total > 0
+            assert len(per) == 4
+            assert sum(per) == total
+            e.sync()
+            assert e.replicas_equal()
+
+    def test_log_append_bench_smoke(self):
+        assert bench_log_append(1 << 12, 2, 16, 50) > 0
