@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""chashbench: the CNR reader/writer CLI — one log per writer
+(`benches/chashbench.rs:91-100`).
+
+Same shape as hashbench but the native engine runs in multi-log mode with
+`nlogs = #writers`, so writer streams on disjoint key classes combine in
+parallel.
+"""
+
+import threading
+import time
+
+from common import base_parser, finish_args
+
+
+def main():
+    p = base_parser("native CNR reader/writer hashmap bench")
+    p.add_argument("-r", "--readers", type=int, default=4)
+    p.add_argument("-w", "--writers", type=int, default=2)
+    p.add_argument("--keys", type=int, default=None)
+    args = finish_args(p.parse_args())
+    keys = args.keys or (1 << 20 if args.full else 10_000)
+    R = args.replicas[0]
+    L = max(args.writers, 1)
+
+    import numpy as np
+
+    from node_replication_tpu.native import MODEL_HASHMAP, NativeEngine
+
+    e = NativeEngine(MODEL_HASHMAP, keys, n_replicas=R,
+                     log_capacity=1 << 18, nlogs=L)
+    stop = threading.Event()
+    counts = {}
+
+    def reader(g):
+        tok = e.register(g % R)
+        rng = np.random.default_rng(g)
+        n = 0
+        while not stop.is_set():
+            for k in rng.integers(0, keys, 1024):
+                e.execute((1, int(k)), tok)
+                n += 1
+            if stop.is_set():
+                break
+        counts[f"r{g}"] = n
+
+    def writer(g):
+        # writer g owns congruence class g (mod L): its ops map to log g,
+        # the one-log-per-writer layout of chashbench.
+        tok = e.register(g % R)
+        rng = np.random.default_rng(1000 + g)
+        n = 0
+        while not stop.is_set():
+            for u in rng.integers(0, keys // L, 1024):
+                k = int(u) * L + g
+                e.execute_mut((1, k % keys, n), tok)
+                n += 1
+            if stop.is_set():
+                break
+        counts[f"w{g}"] = n
+
+    ts = [threading.Thread(target=reader, args=(g,))
+          for g in range(args.readers)]
+    ts += [threading.Thread(target=writer, args=(g,))
+           for g in range(args.writers)]
+    for t in ts:
+        t.start()
+    time.sleep(args.duration)
+    stop.set()
+    for t in ts:
+        t.join()
+    e.sync()
+    assert e.replicas_equal()
+    rd = sum(v for k, v in counts.items() if k.startswith("r"))
+    wr = sum(v for k, v in counts.items() if k.startswith("w"))
+    print(f">> chashbench r={args.readers} w={args.writers} logs={L}: "
+          f"{(rd + wr) / args.duration / 1e6:.2f} Mops "
+          f"(reads {rd / args.duration / 1e6:.2f}, "
+          f"writes {wr / args.duration / 1e6:.2f})")
+    e.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Shared CLI plumbing for the bench suite.
+
+Every bench follows the reference's driver shape (`benches/hashmap.rs:317`
+style `main()`s): parse knobs, build a ScaleBenchBuilder sweep, print
+`>> X Mops` lines, append CSV records. Default sizes are smoke-scale;
+`--full` switches to reference-scale workloads (the `smokebench` feature
+flag inverted, `benches/Cargo.toml`)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def base_parser(desc: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=desc)
+    p.add_argument("--replicas", type=int, nargs="+", default=[4, 16],
+                   help="replica counts to sweep (ReplicaStrategy analog)")
+    p.add_argument("--batch", type=int, nargs="+", default=[32],
+                   help="ops per replica per step (combiner batch)")
+    p.add_argument("--duration", type=float, default=1.0,
+                   help="seconds per config")
+    p.add_argument("--out-dir", default=".", help="CSV output directory")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--full", action="store_true",
+                   help="reference-scale workload sizes")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (debug)")
+    return p
+
+
+def finish_args(args):
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    return args

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""hashbench: reader/writer thread CLI on the native engine
+(`benches/hashbench.rs`: clap `-r/-w/-d` evmap-style bench).
+
+Dedicated reader threads and writer threads hammer one replicated hashmap;
+reports aggregate + per-role throughput. `--replicas` maps threads round-
+robin (the NUMA-node analog).
+"""
+
+import threading
+import time
+
+from common import base_parser, finish_args
+
+
+def main():
+    p = base_parser("native reader/writer hashmap bench")
+    p.add_argument("-r", "--readers", type=int, default=4)
+    p.add_argument("-w", "--writers", type=int, default=2)
+    p.add_argument("-d", "--dist", choices=["uniform", "skewed"],
+                   default="uniform")
+    p.add_argument("--keys", type=int, default=None)
+    args = finish_args(p.parse_args())
+    keys = args.keys or (1 << 20 if args.full else 10_000)
+    R = args.replicas[0]
+
+    import numpy as np
+
+    from node_replication_tpu.native import MODEL_HASHMAP, NativeEngine
+
+    e = NativeEngine(MODEL_HASHMAP, keys, n_replicas=R,
+                     log_capacity=1 << 18)
+    stop = threading.Event()
+    counts = {}
+
+    def key_stream(seed):
+        rng = np.random.default_rng(seed)
+        if args.dist == "skewed":
+            from node_replication_tpu.harness import zipf_keys
+
+            while True:
+                for k in zipf_keys(rng, 4096, keys, 1.03):
+                    yield int(k)
+        while True:
+            for k in rng.integers(0, keys, 4096):
+                yield int(k)
+
+    def reader(g):
+        tok = e.register(g % R)
+        ks = key_stream(g)
+        n = 0
+        while not stop.is_set():
+            e.execute((1, next(ks)), tok)
+            n += 1
+        counts[f"r{g}"] = n
+
+    def writer(g):
+        tok = e.register(g % R)
+        ks = key_stream(1000 + g)
+        n = 0
+        while not stop.is_set():
+            ops = [(1, next(ks), n + j) for j in range(32)]
+            e.execute_mut_batch(ops, tok)
+            n += 32
+        counts[f"w{g}"] = n
+
+    ts = [threading.Thread(target=reader, args=(g,))
+          for g in range(args.readers)]
+    ts += [threading.Thread(target=writer, args=(g,))
+           for g in range(args.writers)]
+    for t in ts:
+        t.start()
+    time.sleep(args.duration)
+    stop.set()
+    for t in ts:
+        t.join()
+    e.sync()
+    assert e.replicas_equal()
+    rd = sum(v for k, v in counts.items() if k.startswith("r"))
+    wr = sum(v for k, v in counts.items() if k.startswith("w"))
+    print(f">> hashbench r={args.readers} w={args.writers} R={R}: "
+          f"{(rd + wr) / args.duration / 1e6:.2f} Mops "
+          f"(reads {rd / args.duration / 1e6:.2f}, "
+          f"writes {wr / args.duration / 1e6:.2f})")
+    e.close()
+
+
+if __name__ == "__main__":
+    main()

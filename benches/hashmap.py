@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Hashmap scale-out bench (`benches/hashmap.rs` port).
+
+Sweeps write ratio × replica count for the NR fleet, with `--cmp` adding
+the partitioned / concurrent / CNR comparison systems (the `cmp` feature,
+`benches/hashmap.rs:336-344`) and `--baseline` running the single-replica
+direct-vs-log comparison (`baseline_comparison`,
+`benches/mkbench.rs:189-319`).
+"""
+
+from common import base_parser, finish_args
+
+from node_replication_tpu.harness import (
+    ScaleBenchBuilder,
+    WorkloadSpec,
+    baseline_comparison,
+)
+from node_replication_tpu.models import make_hashmap
+
+
+def main():
+    p = base_parser("NR hashmap scale-out")
+    p.add_argument("--write-ratios", type=int, nargs="+",
+                   default=[0, 10, 20, 40, 60, 80, 100],
+                   help="write percentages (`benches/hashmap.rs:326`)")
+    p.add_argument("--keys", type=int, default=None)
+    p.add_argument("--cmp", action="store_true",
+                   help="include comparison systems")
+    p.add_argument("--baseline", action="store_true")
+    p.add_argument("--skewed", action="store_true",
+                   help="zipf keys instead of uniform")
+    args = finish_args(p.parse_args())
+
+    keys = args.keys or (1 << 22 if args.full else 10_000)
+    dist = "skewed" if args.skewed else "uniform"
+    if args.baseline:
+        baseline_comparison(
+            lambda: make_hashmap(keys), f"hashmap{keys}",
+            WorkloadSpec(keyspace=keys, write_ratio=50, distribution=dist,
+                         seed=args.seed),
+            duration_s=args.duration, out_dir=args.out_dir,
+        )
+        return
+
+    systems = ["nr"] + (["partitioned", "concurrent", "cnr"] if args.cmp
+                        else [])
+    for wr in args.write_ratios:
+        (
+            ScaleBenchBuilder(
+                lambda: make_hashmap(keys),
+                f"hashmap{keys}-wr{wr}",
+                WorkloadSpec(keyspace=keys, write_ratio=wr,
+                             distribution=dist, seed=args.seed),
+            )
+            .replicas(args.replicas)
+            .log_strategies([1] + ([8] if "cnr" in systems else []))
+            .batches(args.batch)
+            .systems(systems)
+            .duration(args.duration)
+            .out_dir(args.out_dir)
+            .run()
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Lockfree bench: sorted-set (skiplist analog) through CNR, sweeping the
+number of logs 1 → N (`benches/lockfree.rs:243-276`), with the partitioned
+no-log variant as the comparison (`benches/lockfree_partitioned.rs`).
+"""
+
+from common import base_parser, finish_args
+
+from node_replication_tpu.harness import ScaleBenchBuilder, WorkloadSpec
+from node_replication_tpu.models import make_sortedset
+
+
+def main():
+    p = base_parser("CNR sorted-set log sweep")
+    p.add_argument("--keys", type=int, default=None)
+    p.add_argument("--logs", type=int, nargs="+", default=[1, 2, 4, 8])
+    args = finish_args(p.parse_args())
+    keys = args.keys or (1 << 20 if args.full else 1 << 14)
+
+    (
+        ScaleBenchBuilder(
+            lambda: make_sortedset(keys),
+            f"sortedset{keys}",
+            WorkloadSpec(keyspace=keys, write_ratio=80, seed=args.seed),
+        )
+        .replicas(args.replicas)
+        .log_strategies(args.logs)
+        .batches(args.batch)
+        .systems(["nr", "cnr", "partitioned"])
+        .duration(args.duration)
+        .out_dir(args.out_dir)
+        .run()
+    )
+
+
+if __name__ == "__main__":
+    main()

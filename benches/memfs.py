@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""MemFS bench: in-memory FS behind NR (`benches/memfs.rs`).
+
+Reads go through the log as write-ops (FS_READ_LOGGED) per the memfs idiom
+(`benches/memfs.rs:24-86`): all replicas observe the access order, and the
+"write" batch mixes writes with logged reads.
+"""
+
+from common import base_parser, finish_args
+
+from node_replication_tpu.harness import ScaleBenchBuilder, WorkloadSpec
+from node_replication_tpu.harness.mkbench import measure_step_runner
+from node_replication_tpu.harness.trait import ReplicatedRunner
+from node_replication_tpu.harness.workloads import generate_batches
+from node_replication_tpu.models import make_memfs
+
+
+def main():
+    p = base_parser("memfs logged-IO bench")
+    p.add_argument("--files", type=int, default=None)
+    p.add_argument("--blocks", type=int, default=64)
+    args = finish_args(p.parse_args())
+    files = args.files or (4096 if args.full else 256)
+
+    for R in args.replicas:
+        for batch in args.batch:
+            spec = WorkloadSpec(keyspace=files, write_ratio=100,
+                                seed=args.seed)
+            # write batch = FS_WRITE / FS_READ_LOGGED mix; args lanes are
+            # (fd, block, val); block values stay in range via % blocks
+            # inside the model's bounds check.
+            wr_opc, wr_args, rd_opc, rd_args = generate_batches(
+                spec, 16, R, batch, 1, wr_opcode=(1, 3), rd_opcode=2
+            )
+            # keep the block lane in range so writes land
+            wr_args = wr_args.at[..., 1].set(wr_args[..., 1] % args.blocks)
+            wr_args = wr_args.at[..., 2].set(wr_args[..., 1] + 1)
+            gen = (wr_opc, wr_args, rd_opc, rd_args)
+            runner = ReplicatedRunner(
+                make_memfs(files, args.blocks), R, batch, 1
+            )
+            res = measure_step_runner(runner, *gen,
+                                      duration_s=args.duration)
+            print(f">> memfs/nr R={R} batch={batch}: {res.mops:.2f} Mops")
+
+
+if __name__ == "__main__":
+    main()

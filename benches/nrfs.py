@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""NRFS bench: CNR memfs with per-file log partitioning (`benches/nrfs.rs`).
+
+The per-file LogMapper (`fd - 1`, `benches/nrfs.rs:25-39`) becomes the
+MultiLogRunner's congruence re-keying on the fd lane: ops on one file share
+a log, ops on different files replay in parallel — with
+`LogStrategy::Custom(n)` as the `--logs` sweep (`benches/nrfs.rs:132-142`).
+"""
+
+from common import base_parser, finish_args
+
+from node_replication_tpu.harness import WorkloadSpec
+from node_replication_tpu.harness.mkbench import measure_step_runner
+from node_replication_tpu.harness.trait import MultiLogRunner
+from node_replication_tpu.harness.workloads import generate_batches
+from node_replication_tpu.models import make_memfs
+
+
+def main():
+    p = base_parser("nrfs: CNR memfs, per-file logs")
+    p.add_argument("--files", type=int, default=None)
+    p.add_argument("--blocks", type=int, default=64)
+    p.add_argument("--logs", type=int, nargs="+", default=[1, 4, 8])
+    args = finish_args(p.parse_args())
+    files = args.files or (4096 if args.full else 256)
+
+    for R in args.replicas:
+        for L in args.logs:
+            for batch in args.batch:
+                spec = WorkloadSpec(keyspace=files, write_ratio=100,
+                                    seed=args.seed)
+                wr_opc, wr_args, rd_opc, rd_args = generate_batches(
+                    spec, 16, R, batch, 1, wr_opcode=(1, 3), rd_opcode=2
+                )
+                wr_args = wr_args.at[..., 1].set(
+                    wr_args[..., 1] % args.blocks
+                )
+                wr_args = wr_args.at[..., 2].set(wr_args[..., 1] + 1)
+                runner = MultiLogRunner(
+                    make_memfs(files, args.blocks), R, L, batch, 1
+                )
+                res = measure_step_runner(
+                    runner, wr_opc, wr_args, rd_opc, rd_args,
+                    duration_s=args.duration,
+                )
+                print(f">> nrfs/cnr R={R} logs={L} batch={batch}: "
+                      f"{res.mops:.2f} Mops")
+
+
+if __name__ == "__main__":
+    main()

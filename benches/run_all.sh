@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Smoke-run the whole bench suite (the scripts/ci.bash analog: every bench,
+# short durations, CSVs into benches/out/). Pass --full via FULL=1.
+set -euo pipefail
+cd "$(dirname "$0")"
+OUT=out
+mkdir -p "$OUT"
+EXTRA=${FULL:+--full}
+DUR=${DUR:-1.0}
+
+python hashmap.py --replicas 4 16 --write-ratios 0 10 50 100 \
+  --duration "$DUR" --out-dir "$OUT" $EXTRA
+python hashmap.py --baseline --duration "$DUR" --out-dir "$OUT" $EXTRA
+python stack.py --replicas 4 16 --duration "$DUR" $EXTRA
+python synthetic.py --replicas 4 --duration "$DUR" --out-dir "$OUT" $EXTRA
+python vspace.py --replicas 4 --duration "$DUR" $EXTRA
+python memfs.py --replicas 4 --duration "$DUR" $EXTRA
+python nrfs.py --replicas 4 --logs 1 4 --duration "$DUR" $EXTRA
+python lockfree.py --replicas 4 --logs 1 4 --duration "$DUR" \
+  --out-dir "$OUT" $EXTRA
+python log.py --duration "$DUR" $EXTRA
+python hashbench.py -r 2 -w 1 --replicas 2 --duration "$DUR" $EXTRA
+python chashbench.py -r 2 -w 2 --replicas 2 --duration "$DUR" $EXTRA
+python rwlockbench.py -r 1 4 -w 0 1 --duration "$DUR" $EXTRA
+echo "ALL BENCHES OK"

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""RwLock bench (`benches/rwlockbench.rs`): the native distributed
+reader-writer lock under reader/writer thread mixes, vs a plain pthread-
+style exclusive baseline (writers-only config measures the write path).
+"""
+
+from common import base_parser, finish_args
+
+from node_replication_tpu.native.engine import bench_rwlock
+
+
+def main():
+    p = base_parser("distributed rwlock bench")
+    p.add_argument("-r", "--readers", type=int, nargs="+",
+                   default=[1, 4, 8, 16])
+    p.add_argument("-w", "--writers", type=int, nargs="+", default=[0, 1])
+    args = finish_args(p.parse_args())
+
+    for w in args.writers:
+        for r in args.readers:
+            if r == 0 and w == 0:
+                continue
+            total, writes = bench_rwlock(r, w, int(args.duration * 1000))
+            print(f">> rwlock r={r} w={w}: "
+                  f"{total / args.duration / 1e6:.2f} Mops "
+                  f"({writes / args.duration / 1e6:.3f} M writes/s)")
+
+
+if __name__ == "__main__":
+    main()

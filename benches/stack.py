@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Stack bench: 50/50 push/pop, write-only workload (`benches/stack.rs`).
+
+Runs the baseline comparison plus the scale-out sweep; pop-on-empty and
+push-on-full replay as deterministic no-effect ops so the workload needs
+no coordination.
+"""
+
+from common import base_parser, finish_args
+
+from node_replication_tpu.harness import ScaleBenchBuilder, WorkloadSpec
+from node_replication_tpu.harness.mkbench import measure_step_runner
+from node_replication_tpu.harness.trait import ReplicatedRunner
+from node_replication_tpu.harness.workloads import generate_batches
+from node_replication_tpu.models import make_stack
+
+
+def main():
+    p = base_parser("NR stack push/pop")
+    p.add_argument("--capacity", type=int, default=None)
+    args = finish_args(p.parse_args())
+    cap = args.capacity or (1 << 22 if args.full else 1 << 16)
+
+    for R in args.replicas:
+        for batch in args.batch:
+            spec = WorkloadSpec(keyspace=1 << 30, write_ratio=100,
+                                seed=args.seed)
+            # 50/50 push/pop via uniform opcode choice; one token read lane
+            # (peek) keeps the read path exercised.
+            gen = generate_batches(
+                spec, 16, R, batch, 1, wr_opcode=(1, 2), rd_opcode=1
+            )
+            runner = ReplicatedRunner(make_stack(cap), R, batch, 1)
+            res = measure_step_runner(runner, *gen,
+                                      duration_s=args.duration)
+            assert runner.replicas_equal()
+            print(f">> stack/nr R={R} batch={batch}: {res.mops:.2f} Mops")
+
+
+if __name__ == "__main__":
+    main()

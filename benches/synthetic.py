@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Synthetic op-cost × replica-count sweep (`benches/synthetic.rs`).
+
+The tunable AbstractDataStructure: `n` state lines, each write touching
+`cold_reads/cold_writes/hot_reads/hot_writes` lines (defaults 200k/20/5/2/1,
+`benches/synthetic.rs:75-79`). Sweeps op cost against fleet size to expose
+where replay cost dominates log cost.
+"""
+
+from common import base_parser, finish_args
+
+from node_replication_tpu.harness import ScaleBenchBuilder, WorkloadSpec
+from node_replication_tpu.models import make_synthetic
+
+
+def main():
+    p = base_parser("synthetic abstract-DS sweep")
+    p.add_argument("--lines", type=int, default=None)
+    p.add_argument("--cold-writes", type=int, nargs="+", default=[1, 5, 20],
+                   help="cold lines written per op (op-cost axis)")
+    args = finish_args(p.parse_args())
+    n = args.lines or (200_000 if args.full else 20_000)
+
+    for cw in args.cold_writes:
+        (
+            ScaleBenchBuilder(
+                lambda cw=cw: make_synthetic(
+                    n=n, cold_reads=20, cold_writes=cw, hot_reads=2,
+                    hot_writes=1,
+                ),
+                f"synthetic-n{n}-cw{cw}",
+                WorkloadSpec(keyspace=1 << 30, write_ratio=50,
+                             seed=args.seed),
+            )
+            .replicas(args.replicas)
+            .batches(args.batch)
+            .duration(args.duration)
+            .out_dir(args.out_dir)
+            .run()
+        )
+
+
+if __name__ == "__main__":
+    main()

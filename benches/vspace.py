@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""VSpace bench: page-table map/unmap replay (`benches/vspace.rs`).
+
+The NrOS use-case: a virtual address space replayed through the log. The
+workload maps multi-page spans (VS_MAP) with occasional unmaps, reading
+back translations (VS_IDENTIFY) — a long-log replay with wide scatters per
+entry.
+"""
+
+from common import base_parser, finish_args
+
+from node_replication_tpu.harness import ScaleBenchBuilder, WorkloadSpec
+from node_replication_tpu.models import make_vspace
+
+
+def main():
+    p = base_parser("vspace map/unmap replay")
+    p.add_argument("--pages", type=int, default=None)
+    p.add_argument("--span", type=int, default=8,
+                   help="max pages per map op (fixed scatter width)")
+    args = finish_args(p.parse_args())
+    pages = args.pages or (1 << 24 if args.full else 1 << 18)
+
+    from node_replication_tpu.harness.mkbench import measure_step_runner
+    from node_replication_tpu.harness.trait import ReplicatedRunner
+    from node_replication_tpu.harness.workloads import generate_batches
+
+    for R in args.replicas:
+        for batch in args.batch:
+            spec = WorkloadSpec(keyspace=pages, write_ratio=75,
+                                seed=args.seed)
+            wr_opc, wr_args, rd_opc, rd_args = generate_batches(
+                spec, 16, R, batch, 1, wr_opcode=(1, 1, 1, 2), rd_opcode=1
+            )
+            # arg lanes: (vpage, pframe, npages) — give every op a real
+            # span so maps/unmaps touch 1..span pages
+            wr_args = wr_args.at[..., 2].set(
+                1 + (wr_args[..., 1] % args.span)
+            )
+            runner = ReplicatedRunner(
+                make_vspace(pages, max_span=args.span), R, batch, 1
+            )
+            res = measure_step_runner(
+                runner, wr_opc, wr_args, rd_opc, rd_args,
+                duration_s=args.duration,
+            )
+            print(f">> vspace/nr R={R} batch={batch}: {res.mops:.2f} Mops"
+                  f" (pages touched ≤{args.span}/op)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Benchmark/test harness: the mkbench equivalent (`benches/mkbench.rs`).
+
+- `trait`     — the ReplicaTrait abstraction: one runner protocol that NR
+                fleets, CNR multi-log fleets, partitioned comparisons,
+                single concurrent-DS baselines, and the native CPU engine
+                all implement (`benches/mkbench.rs:77-139`).
+- `workloads` — op-stream generators (uniform/zipf keys, write-ratio mix),
+                the port of `benches/hashmap.rs:131-162`.
+- `mkbench`   — ScaleBenchBuilder sweeps, baseline_comparison, CSV output,
+                `>> X Mops` reporting (`benches/mkbench.rs:189-319`,
+                `950-1182`).
+"""
+
+from node_replication_tpu.harness.trait import (
+    ConcurrentDsRunner,
+    FleetRunner,
+    MultiLogRunner,
+    NativeRunner,
+    PartitionedRunner,
+    ReplicatedRunner,
+)
+from node_replication_tpu.harness.workloads import (
+    WorkloadSpec,
+    generate_batches,
+    zipf_keys,
+)
+from node_replication_tpu.harness.mkbench import (
+    ScaleBenchBuilder,
+    baseline_comparison,
+)
+
+__all__ = [
+    "FleetRunner",
+    "ReplicatedRunner",
+    "MultiLogRunner",
+    "PartitionedRunner",
+    "ConcurrentDsRunner",
+    "NativeRunner",
+    "WorkloadSpec",
+    "generate_batches",
+    "zipf_keys",
+    "ScaleBenchBuilder",
+    "baseline_comparison",
+]

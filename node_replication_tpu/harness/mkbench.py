@@ -1,0 +1,299 @@
+"""ScaleBench harness: sweeps, measurement, CSV output.
+
+The mkbench equivalent (`benches/mkbench.rs`):
+
+- `ScaleBenchBuilder` — cross-product sweeps of (replica count ×
+  log strategy × batch size), mirroring `ScaleBenchBuilder::configure`'s
+  (ReplicaStrategy × LogStrategy × ThreadMapping × #threads × batch)
+  matrix (`benches/mkbench.rs:950-1182`). Replica placement strategies are
+  mesh shapes on TPU, so the sweep axis is the simulated replica count and
+  the log shard count.
+- per-second throughput capture and CSV records with the reference's
+  column shape (name, rs, ls, tm, batch, threads, duration, thread_id,
+  core_id, second, ops — `benches/mkbench.rs:498-552`).
+- `>> X Mops (min, max)` stdout summaries (`benches/mkbench.rs:592-604`).
+- `baseline_comparison` — single-replica, same workload, data structure
+  direct vs behind-the-log (`benches/mkbench.rs:189-319`).
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import os
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+from node_replication_tpu.harness.trait import (
+    ConcurrentDsRunner,
+    FleetRunner,
+    MultiLogRunner,
+    NativeRunner,
+    PartitionedRunner,
+    ReplicatedRunner,
+)
+from node_replication_tpu.harness.workloads import (
+    WorkloadSpec,
+    generate_batches,
+    split_write_read,
+)
+
+SCALEOUT_CSV = "scaleout_benchmarks.csv"
+BASELINE_CSV = "baseline_comparison.csv"
+_CSV_FIELDS = [
+    "name", "rs", "ls", "tm", "batch", "threads", "duration",
+    "thread_id", "core_id", "second", "ops",
+]
+
+
+def _append_csv(path: str, fields: list[str], rows: list[dict]) -> None:
+    fresh = not os.path.exists(path)
+    with open(path, "a", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fields)
+        if fresh:
+            w.writeheader()
+        w.writerows(rows)
+
+
+@dataclasses.dataclass
+class MeasureResult:
+    name: str
+    total_dispatches: int
+    duration_s: float
+    per_second: list[tuple[int, int]]  # (second, dispatches)
+
+    @property
+    def mops(self) -> float:
+        return self.total_dispatches / self.duration_s / 1e6
+
+
+def measure_step_runner(
+    runner: FleetRunner,
+    wr_opc,
+    wr_args,
+    rd_opc,
+    rd_args,
+    duration_s: float = 2.0,
+    warmup_steps: int = 3,
+    chunk: int = 8,
+) -> MeasureResult:
+    """Drive a step runner for ~`duration_s`, bucketing dispatch counts by
+    wall-clock second (the per-second capture of
+    `benches/mkbench.rs:755-761`). Steps cycle over the pre-staged
+    workload."""
+    S = wr_opc.shape[0]
+    runner.prepare(wr_opc, wr_args, rd_opc, rd_args)
+    for s in range(min(warmup_steps, S)):
+        runner.run_step(s)
+    runner.block()
+
+    buckets: dict[int, int] = {}
+    total = 0
+    idx = 0
+    t0 = time.perf_counter()
+    while True:
+        for _ in range(chunk):
+            runner.run_step(idx % S)
+            idx += 1
+        runner.block()
+        now = time.perf_counter()
+        done = chunk * runner.dispatches_per_step
+        total += done
+        buckets[int(now - t0)] = buckets.get(int(now - t0), 0) + done
+        if now - t0 >= duration_s:
+            break
+    dur = time.perf_counter() - t0
+    return MeasureResult(
+        name=runner.name,
+        total_dispatches=total,
+        duration_s=dur,
+        per_second=sorted(buckets.items()),
+    )
+
+
+def baseline_comparison(
+    dispatch_factory: Callable,
+    name: str,
+    workload: WorkloadSpec,
+    batch_sizes: Sequence[int] = (1, 8, 32, 128),
+    duration_s: float = 1.0,
+    out_dir: str = ".",
+    log_capacity: int | None = None,
+) -> list[MeasureResult]:
+    """Single-replica baseline: the same op stream applied to the data
+    structure directly vs through the log (`baseline_comparison`,
+    `benches/mkbench.rs:189-319`). Quantifies log overhead per batch size.
+    Writes `baseline_comparison.csv`."""
+    results = []
+    rows = []
+    for batch in batch_sizes:
+        bw, br = split_write_read(batch, workload.write_ratio)
+        gen = generate_batches(workload, 16, 1, bw, br)
+        for system in ("direct", "log"):
+            if system == "direct":
+                runner: FleetRunner = ConcurrentDsRunner(
+                    dispatch_factory(), 1, bw, br
+                )
+            else:
+                runner = ReplicatedRunner(
+                    dispatch_factory(), 1, bw, br, log_capacity=log_capacity
+                )
+            res = measure_step_runner(
+                runner, *gen, duration_s=duration_s
+            )
+            res.name = f"{name}-{system}"
+            results.append(res)
+            rows.append(
+                {
+                    "name": name,
+                    "rs": "one",
+                    "ls": system,
+                    "tm": "none",
+                    "batch": batch,
+                    "threads": 1,
+                    "duration": round(res.duration_s, 3),
+                    "thread_id": 0,
+                    "core_id": 0,
+                    "second": -1,
+                    "ops": res.total_dispatches,
+                }
+            )
+            print(f">> {res.name} batch={batch}: {res.mops:.2f} Mops")
+    _append_csv(os.path.join(out_dir, BASELINE_CSV), _CSV_FIELDS, rows)
+    return results
+
+
+class ScaleBenchBuilder:
+    """Sweep builder (`ScaleBenchBuilder`, `benches/mkbench.rs:1041-1093`).
+
+    Axes: replica counts (ReplicaStrategy analog — how many lock-step
+    replicas the fleet simulates), log strategy (1 = NR single log, n > 1 =
+    CNR key-partitioned logs, `LogStrategy::Custom(n)`), ops per replica
+    per step (combiner batch), and the comparison systems to include.
+    """
+
+    def __init__(self, dispatch_factory: Callable, name: str,
+                 workload: WorkloadSpec | None = None):
+        self.dispatch_factory = dispatch_factory
+        self.name = name
+        self.workload = workload or WorkloadSpec()
+        self._replicas = [4]
+        self._log_strategies = [1]
+        self._batches = [32]
+        self._systems = ["nr"]
+        self._duration_s = 2.0
+        self._steps = 16
+        self._log_capacity: int | None = None
+        self._out_dir = "."
+
+    def replicas(self, counts: Sequence[int]):
+        self._replicas = list(counts)
+        return self
+
+    def log_strategies(self, ns: Sequence[int]):
+        self._log_strategies = list(ns)
+        return self
+
+    def batches(self, bs: Sequence[int]):
+        self._batches = list(bs)
+        return self
+
+    def systems(self, names: Sequence[str]):
+        """Subset of {nr, cnr, partitioned, concurrent}."""
+        self._systems = list(names)
+        return self
+
+    def duration(self, seconds: float):
+        self._duration_s = seconds
+        return self
+
+    def log_capacity(self, entries: int):
+        self._log_capacity = entries
+        return self
+
+    def out_dir(self, path: str):
+        self._out_dir = path
+        return self
+
+    def _make_runner(self, system: str, nlogs: int, R: int, bw: int,
+                     br: int) -> FleetRunner | None:
+        d = self.dispatch_factory()
+        if system == "nr" and nlogs == 1:
+            return ReplicatedRunner(d, R, bw, br, self._log_capacity)
+        if system == "cnr" and nlogs > 1:
+            return MultiLogRunner(d, R, nlogs, bw, br, self._log_capacity)
+        if system == "partitioned" and nlogs == 1:
+            return PartitionedRunner(d, R, bw, br)
+        if system == "concurrent" and nlogs == 1:
+            return ConcurrentDsRunner(d, R, bw, br)
+        return None
+
+    def run(self) -> list[MeasureResult]:
+        """Execute the full cross-product; print Mops lines and append
+        per-second CSV records (`scaleout_benchmarks.csv`)."""
+        results = []
+        rows = []
+        for R in self._replicas:
+            for nlogs in self._log_strategies:
+                for batch in self._batches:
+                    bw, br = split_write_read(
+                        batch, self.workload.write_ratio
+                    )
+                    for system in self._systems:
+                        runner = self._make_runner(
+                            system, nlogs, R, bw, br
+                        )
+                        if runner is None:
+                            continue
+                        gen = generate_batches(
+                            self.workload, self._steps, R, bw, br
+                        )
+                        res = measure_step_runner(
+                            runner, *gen, duration_s=self._duration_s
+                        )
+                        results.append(res)
+                        per_r = res.total_dispatches // R
+                        print(
+                            f">> {self.name}/{runner.name} R={R} "
+                            f"logs={nlogs} batch={batch}: "
+                            f"{res.mops:.2f} Mops "
+                            f"({per_r / res.duration_s / 1e6:.3f} "
+                            f"Mops/replica)"
+                        )
+                        for sec, ops in res.per_second:
+                            rows.append(
+                                {
+                                    "name": f"{self.name}/{runner.name}",
+                                    "rs": R,
+                                    "ls": nlogs,
+                                    "tm": "none",
+                                    "batch": batch,
+                                    "threads": R,
+                                    "duration": round(res.duration_s, 3),
+                                    "thread_id": 0,
+                                    "core_id": 0,
+                                    "second": sec,
+                                    "ops": ops,
+                                }
+                            )
+        _append_csv(
+            os.path.join(self._out_dir, SCALEOUT_CSV), _CSV_FIELDS, rows
+        )
+        return results
+
+
+def measure_native(
+    runner: NativeRunner, duration_s: float = 2.0, seed: int = 1
+) -> MeasureResult:
+    """Measure a native-engine runner (threads in C++; per-thread counts
+    become the per-'core' CSV records)."""
+    total, per = runner.run_duration(int(duration_s * 1000), seed)
+    return MeasureResult(
+        name=runner.name,
+        total_dispatches=int(total),
+        duration_s=duration_s,
+        per_second=[(s, int(total / max(duration_s, 1)))
+                    for s in range(int(duration_s))],
+    )

@@ -1,0 +1,325 @@
+"""The ReplicaTrait abstraction: one runner protocol for every system.
+
+The reference's harness runs NR replicas, CNR replicas, partitioned data
+structures, and plain concurrent data structures under one `ReplicaTrait`
+(`benches/mkbench.rs:77-139`), with `Partitioner<T>` and `ConcurrentDs<T>`
+as the comparison wrappers (`benches/hashmap_comparisons.rs:25-142`). The
+TPU equivalents here are *fleet step runners*: each owns pre-staged
+`[S, R, B]` workload arrays and exposes `run_step(s)` as one device
+computation, plus the native CPU engine as a duration-based runner.
+
+Dispatch accounting is honest per SURVEY.md §7: `dispatches_per_step`
+counts *executed* dispatches — NR replay applies every appended entry on
+every replica (R × span), partitioned/concurrent baselines apply each op
+once.
+"""
+
+from __future__ import annotations
+
+import abc
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from node_replication_tpu.core.log import LogSpec, log_init
+from node_replication_tpu.core.multilog import (
+    MultiLogSpec,
+    make_multilog_step,
+    multilog_init,
+)
+from node_replication_tpu.core.replica import replicate_state
+from node_replication_tpu.core.step import make_step
+from node_replication_tpu.ops.encoding import (
+    Dispatch,
+    apply_write,
+    dispatch_reads,
+)
+
+
+class FleetRunner(abc.ABC):
+    """A system under test, driven step-by-step over pre-staged batches."""
+
+    name: str = "base"
+    n_replicas: int = 1
+    dispatches_per_step: int = 0
+
+    @abc.abstractmethod
+    def prepare(self, wr_opc, wr_args, rd_opc, rd_args) -> None:
+        """Stage `[S, R, B]`-shaped workload arrays on device."""
+
+    @abc.abstractmethod
+    def run_step(self, s: int) -> None:
+        """Execute step `s` (asynchronously; call `block()` to fence)."""
+
+    def block(self) -> None:
+        """Fence outstanding device work."""
+
+    def state_dump(self, rid: int = 0):
+        """Replica state as a host pytree (the verify hook)."""
+        raise NotImplementedError
+
+    def replicas_equal(self) -> bool:
+        return True
+
+
+class ReplicatedRunner(FleetRunner):
+    """NR: R replicas behind one shared log (`nr` crate equivalent)."""
+
+    def __init__(self, dispatch: Dispatch, n_replicas: int,
+                 writes_per_replica: int, reads_per_replica: int,
+                 log_capacity: int | None = None):
+        self.name = "nr"
+        self.dispatch = dispatch
+        self.n_replicas = n_replicas
+        self.Bw, self.Br = writes_per_replica, reads_per_replica
+        span = n_replicas * writes_per_replica
+        self.spec = LogSpec(
+            capacity=log_capacity or max(4 * span, 1 << 14),
+            n_replicas=n_replicas,
+            arg_width=dispatch.arg_width,
+            gc_slack=min(8192, span),
+        )
+        self.step = make_step(dispatch, self.spec, self.Bw, self.Br)
+        self.log = log_init(self.spec)
+        self.states = replicate_state(dispatch.init_state(), n_replicas)
+        # Each appended entry is replayed by every replica + local reads.
+        self.dispatches_per_step = n_replicas * span + n_replicas * self.Br
+
+    def prepare(self, wr_opc, wr_args, rd_opc, rd_args):
+        self._w = (jax.device_put(wr_opc), jax.device_put(wr_args))
+        self._r = (jax.device_put(rd_opc), jax.device_put(rd_args))
+
+    def run_step(self, s: int):
+        self.log, self.states, _, self._last = self.step(
+            self.log, self.states,
+            self._w[0][s], self._w[1][s], self._r[0][s], self._r[1][s],
+        )
+
+    def block(self):
+        jax.block_until_ready((self.log, self.states))
+
+    def state_dump(self, rid: int = 0):
+        return jax.tree.map(lambda a: np.asarray(a[rid]), self.states)
+
+    def replicas_equal(self) -> bool:
+        return all(
+            jax.tree.leaves(
+                jax.tree.map(
+                    lambda a: bool(
+                        np.all(np.asarray(a) == np.asarray(a)[0:1])
+                    ),
+                    self.states,
+                )
+            )
+        )
+
+
+class MultiLogRunner(FleetRunner):
+    """CNR: R replicas behind L key-partitioned logs (`cnr` equivalent).
+
+    Workload writes are re-keyed onto congruence classes (`key ≡ log (mod
+    L)`) at prepare time — the LogMapper partition made structural so the
+    per-log batches keep static shapes.
+    """
+
+    def __init__(self, dispatch: Dispatch, n_replicas: int, nlogs: int,
+                 writes_per_log: int, reads_per_replica: int,
+                 log_capacity: int | None = None):
+        self.name = f"cnr{nlogs}"
+        self.dispatch = dispatch
+        self.n_replicas = n_replicas
+        self.nlogs = nlogs
+        self.B, self.Br = writes_per_log, reads_per_replica
+        self.spec = MultiLogSpec(
+            nlogs=nlogs,
+            capacity=log_capacity or max(4 * writes_per_log, 1 << 12),
+            n_replicas=n_replicas,
+            arg_width=dispatch.arg_width,
+            gc_slack=min(1024, writes_per_log),
+        )
+        self.step = make_multilog_step(
+            dispatch, self.spec, self.B, self.Br
+        )
+        self.ml = multilog_init(self.spec)
+        self.states = replicate_state(dispatch.init_state(), n_replicas)
+        span = nlogs * writes_per_log
+        self.dispatches_per_step = n_replicas * span + n_replicas * self.Br
+
+    def prepare(self, wr_opc, wr_args, rd_opc, rd_args):
+        # Reshape [S, R, Bw] → [S, L, B] buckets and re-key each bucket
+        # onto its congruence class so the LogMapper invariant holds.
+        S = wr_opc.shape[0]
+        A = wr_args.shape[-1]
+        if self.B == 0:  # read-only sweep: no write buckets
+            self._w = (
+                jnp.zeros((S, self.nlogs, 0), jnp.int32),
+                jnp.zeros((S, self.nlogs, 0, A), jnp.int32),
+            )
+            self._counts = jnp.zeros((self.nlogs,), jnp.int64)
+            self._r = (jax.device_put(rd_opc), jax.device_put(rd_args))
+            return
+        flat_opc = np.asarray(wr_opc).reshape(S, -1)
+        flat_args = np.asarray(wr_args).reshape(S, -1, wr_args.shape[-1])
+        need = self.nlogs * self.B
+        if flat_opc.shape[1] < need:
+            reps = -(-need // flat_opc.shape[1])
+            flat_opc = np.tile(flat_opc, (1, reps))
+            flat_args = np.tile(flat_args, (1, reps, 1))
+        flat_opc = flat_opc[:, :need].reshape(S, self.nlogs, self.B)
+        flat_args = flat_args[:, :need].reshape(
+            S, self.nlogs, self.B, -1
+        ).copy()
+        lanes = np.arange(self.nlogs, dtype=np.int32)[None, :, None]
+        flat_args[..., 0] = (
+            flat_args[..., 0] // self.nlogs
+        ) * self.nlogs + lanes
+        self._w = (jnp.asarray(flat_opc), jnp.asarray(flat_args))
+        self._counts = jnp.full((self.nlogs,), self.B, jnp.int64)
+        self._r = (jax.device_put(rd_opc), jax.device_put(rd_args))
+
+    def run_step(self, s: int):
+        self.ml, self.states, _, self._last = self.step(
+            self.ml, self.states, self._w[0][s], self._w[1][s],
+            self._counts, self._r[0][s], self._r[1][s],
+        )
+
+    def block(self):
+        jax.block_until_ready((self.ml, self.states))
+
+    def state_dump(self, rid: int = 0):
+        return jax.tree.map(lambda a: np.asarray(a[rid]), self.states)
+
+
+class PartitionedRunner(FleetRunner):
+    """`Partitioner<T>` comparison (`benches/hashmap_comparisons.rs:25-84`):
+    one data structure per replica, NO shared log — each shard applies only
+    its own batch. The no-replication upper bound on write scaling."""
+
+    def __init__(self, dispatch: Dispatch, n_replicas: int,
+                 writes_per_replica: int, reads_per_replica: int):
+        self.name = "partitioned"
+        self.dispatch = dispatch
+        self.n_replicas = n_replicas
+        self.Bw, self.Br = writes_per_replica, reads_per_replica
+        self.states = replicate_state(dispatch.init_state(), n_replicas)
+        self.dispatches_per_step = n_replicas * (self.Bw + self.Br)
+
+        def step(states, wr_opc, wr_args, rd_opc, rd_args):
+            def one(state, opcs, args):
+                def body(st, x):
+                    o, a = x
+                    st, resp = apply_write(dispatch, st, o, a)
+                    return st, resp
+
+                return jax.lax.scan(body, state, (opcs, args))
+
+            states, wr = jax.vmap(one)(states, wr_opc, wr_args)
+            rd = dispatch_reads(dispatch, states, rd_opc, rd_args)
+            return states, wr, rd
+
+        self.step = jax.jit(step, donate_argnums=(0,))
+
+    def prepare(self, wr_opc, wr_args, rd_opc, rd_args):
+        self._w = (jax.device_put(wr_opc), jax.device_put(wr_args))
+        self._r = (jax.device_put(rd_opc), jax.device_put(rd_args))
+
+    def run_step(self, s: int):
+        self.states, _, self._last = self.step(
+            self.states, self._w[0][s], self._w[1][s],
+            self._r[0][s], self._r[1][s],
+        )
+
+    def block(self):
+        jax.block_until_ready(self.states)
+
+    def state_dump(self, rid: int = 0):
+        return jax.tree.map(lambda a: np.asarray(a[rid]), self.states)
+
+
+class ConcurrentDsRunner(FleetRunner):
+    """`ConcurrentDs<T>` passthrough (`benches/hashmap_comparisons.rs:
+    92-142`): ONE un-replicated data structure; the whole fleet's ops fold
+    into it sequentially. The single-structure baseline."""
+
+    def __init__(self, dispatch: Dispatch, n_replicas: int,
+                 writes_per_replica: int, reads_per_replica: int):
+        self.name = "concurrent"
+        self.dispatch = dispatch
+        self.n_replicas = n_replicas
+        self.Bw, self.Br = writes_per_replica, reads_per_replica
+        self.state = dispatch.init_state()
+        self.dispatches_per_step = n_replicas * (self.Bw + self.Br)
+
+        def step(state, wr_opc, wr_args, rd_opc, rd_args):
+            def body(st, x):
+                o, a = x
+                st, resp = apply_write(dispatch, st, o, a)
+                return st, resp
+
+            A = wr_args.shape[-1]
+            state, wr = jax.lax.scan(
+                body, state, (wr_opc.reshape(-1), wr_args.reshape(-1, A))
+            )
+            rd = dispatch_reads(
+                dispatch,
+                jax.tree.map(lambda x: x[None], state),
+                rd_opc.reshape(1, -1),
+                rd_args.reshape(1, -1, A),
+            )
+            return state, wr, rd
+
+        self.step = jax.jit(step, donate_argnums=(0,))
+
+    def prepare(self, wr_opc, wr_args, rd_opc, rd_args):
+        self._w = (jax.device_put(wr_opc), jax.device_put(wr_args))
+        self._r = (jax.device_put(rd_opc), jax.device_put(rd_args))
+
+    def run_step(self, s: int):
+        self.state, _, self._last = self.step(
+            self.state, self._w[0][s], self._w[1][s],
+            self._r[0][s], self._r[1][s],
+        )
+
+    def block(self):
+        jax.block_until_ready(self.state)
+
+    def state_dump(self, rid: int = 0):
+        return jax.tree.map(np.asarray, self.state)
+
+
+class NativeRunner:
+    """The native CPU engine as a duration-based runner (real OS threads;
+    the measured loop lives in C++, `nr_bench_hashmap`)."""
+
+    def __init__(self, model: int, model_param: int, n_replicas: int,
+                 threads_per_replica: int, write_pct: int, keyspace: int,
+                 nlogs: int = 1, batch: int = 32,
+                 log_capacity: int = 1 << 18):
+        from node_replication_tpu.native import NativeEngine
+
+        self.name = f"native{'-cnr' + str(nlogs) if nlogs > 1 else ''}"
+        self.n_replicas = n_replicas
+        self.threads_per_replica = threads_per_replica
+        self.write_pct = write_pct
+        self.keyspace = keyspace
+        self.batch = batch
+        self.engine = NativeEngine(
+            model, model_param, n_replicas, log_capacity, nlogs
+        )
+
+    def run_duration(self, duration_ms: int, seed: int = 1):
+        """Returns (total_ops, per_thread_ops ndarray)."""
+        return self.engine.bench_hashmap(
+            self.threads_per_replica, self.write_pct, self.keyspace,
+            self.batch, duration_ms, seed,
+        )
+
+    def replicas_equal(self) -> bool:
+        self.engine.sync()
+        return self.engine.replicas_equal()
+
+    def close(self):
+        self.engine.close()

@@ -44,7 +44,12 @@ def build(force: bool = False) -> str:
             _SRC,
         ]
         try:
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build failed (g++ exit {proc.returncode}):\n"
+                    f"{proc.stderr}"
+                )
             os.replace(tmp, _SO)
         finally:
             if os.path.exists(tmp):

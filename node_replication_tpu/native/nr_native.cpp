@@ -93,8 +93,12 @@ void nr_rwlock_destroy(NrRwLock *l) {
 void nr_rwlock_read_acquire(NrRwLock *l, int slot) {
   for (;;) {
     while (l->wlock.load(std::memory_order_relaxed)) cpu_relax();
-    l->readers[slot].v.fetch_add(1, std::memory_order_acq_rel);
-    if (!l->wlock.load(std::memory_order_acquire)) return;
+    // seq_cst on the announce/check pair: reader announces (RMW) then
+    // checks wlock, writer announces (CAS) then checks readers — the
+    // store-buffer pattern. Weaker orderings allow both to pass on
+    // non-TSO targets.
+    l->readers[slot].v.fetch_add(1, std::memory_order_seq_cst);
+    if (!l->wlock.load(std::memory_order_seq_cst)) return;
     // Writer raced in: back off and retry.
     l->readers[slot].v.fetch_sub(1, std::memory_order_acq_rel);
   }
@@ -107,13 +111,13 @@ void nr_rwlock_read_release(NrRwLock *l, int slot) {
 void nr_rwlock_write_acquire(NrRwLock *l) {
   uint32_t expect = 0;
   while (!l->wlock.compare_exchange_weak(expect, 1,
-                                         std::memory_order_acq_rel,
+                                         std::memory_order_seq_cst,
                                          std::memory_order_relaxed)) {
     expect = 0;
     cpu_relax();
   }
   for (int i = 0; i < l->n_slots; i++)
-    while (l->readers[i].v.load(std::memory_order_acquire)) cpu_relax();
+    while (l->readers[i].v.load(std::memory_order_seq_cst)) cpu_relax();
 }
 
 void nr_rwlock_write_release(NrRwLock *l) {
@@ -329,6 +333,7 @@ struct Replica {
 struct Engine {
   const Model *model;
   int model_id;
+  int64_t model_param;
   int n_replicas;
   int nlogs;
   Log *logs;          // nlogs (atomics: not vector-movable)
@@ -342,11 +347,16 @@ Engine *nr_engine_create(int model_id, int64_t model_param, int n_replicas,
                          uint64_t log_capacity, int nlogs) {
   if (model_id <= 0 || model_id >= kNumModels) return nullptr;
   if (n_replicas < 1 || n_replicas > kMaxReplicas) return nullptr;
+  if (model_param < 1) return nullptr;  // zero-size models div-by-zero
+  // A combiner batch (up to kMaxBatch*8 ops) must always fit under the GC
+  // slack reserve or log_append can never succeed.
+  if (log_capacity < 1024) return nullptr;
   const Model *m = &kModels[model_id];
   if (nlogs > 1 && !m->concurrent_ok) return nullptr;
   auto *e = new Engine();
   e->model = m;
   e->model_id = model_id;
+  e->model_param = model_param;
   e->n_replicas = n_replicas;
   e->nlogs = nlogs < 1 ? 1 : nlogs;
   e->logs = new Log[e->nlogs]();
@@ -511,9 +521,14 @@ static bool try_combine(Engine *e, int rid, int li) {
 
 static inline int map_log(Engine *e, const int32_t *args) {
   // Native LogMapper: key-partitioned (`hash % nlogs`,
-  // `cnr/src/replica.rs:435`). args[0] is the key lane for both models.
+  // `cnr/src/replica.rs:435`). The key must be canonicalized exactly as
+  // the model canonicalizes it (mod model_param): two raw keys that alias
+  // the same cell conflict, so they MUST map to the same log
+  // (`cnr/src/lib.rs:123-137`).
   if (e->nlogs == 1) return 0;
-  return (int)(((uint32_t)args[0]) % (uint32_t)e->nlogs);
+  int64_t k = ((int64_t)args[0] % e->model_param + e->model_param) %
+              e->model_param;
+  return (int)((uint64_t)k % (uint64_t)e->nlogs);
 }
 
 // Batched write path: stage up to kMaxBatch ops and wait for responses

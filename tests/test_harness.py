@@ -1,0 +1,166 @@
+"""Harness tests: workload generation, runner correctness, sweep + CSV.
+
+Mirrors the reference harness's role (`benches/mkbench.rs`): every system
+(NR, CNR, partitioned, concurrent baseline) must run the same workloads
+under one protocol, and the NR fleet must agree with the un-replicated
+baseline on final state (the strongest cross-system differential).
+"""
+
+import csv
+import os
+
+import numpy as np
+import pytest
+
+from node_replication_tpu.harness import (
+    ConcurrentDsRunner,
+    MultiLogRunner,
+    PartitionedRunner,
+    ReplicatedRunner,
+    ScaleBenchBuilder,
+    WorkloadSpec,
+    baseline_comparison,
+    generate_batches,
+    zipf_keys,
+)
+from node_replication_tpu.harness.mkbench import measure_step_runner
+from node_replication_tpu.harness.workloads import split_write_read
+from node_replication_tpu.models import make_hashmap
+
+
+class TestWorkloads:
+    def test_shapes_and_determinism(self):
+        spec = WorkloadSpec(keyspace=100, seed=3)
+        a = generate_batches(spec, 4, 2, 3, 5)
+        b = generate_batches(spec, 4, 2, 3, 5)
+        assert a[0].shape == (4, 2, 3)
+        assert a[1].shape == (4, 2, 3, 3)
+        assert a[2].shape == (4, 2, 5)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        assert np.asarray(a[1])[..., 0].max() < 100
+
+    def test_zipf_skew(self):
+        rng = np.random.default_rng(0)
+        ks = zipf_keys(rng, 20_000, 1000, theta=1.2)
+        assert ks.min() >= 0 and ks.max() < 1000
+        # zipf: the hottest key dominates a uniform draw's share
+        hot_share = np.bincount(ks, minlength=1000).max() / len(ks)
+        assert hot_share > 0.05
+
+    def test_split_write_read(self):
+        assert split_write_read(10, 0) == (0, 10)
+        assert split_write_read(10, 100) == (10, 0)
+        bw, br = split_write_read(10, 50)
+        assert bw + br == 10 and bw >= 1 and br >= 1
+
+
+class TestRunnerCorrectness:
+    def test_nr_matches_concurrent_baseline(self):
+        # Same op stream: NR fleet replicas must converge to exactly the
+        # state of the single un-replicated structure (log linearization
+        # changes nothing observable).
+        spec = WorkloadSpec(keyspace=64, seed=11)
+        gen = generate_batches(spec, 6, 2, 4, 2)
+        nr = ReplicatedRunner(make_hashmap(64), 2, 4, 2, log_capacity=1 << 10)
+        cc = ConcurrentDsRunner(make_hashmap(64), 2, 4, 2)
+        nr.prepare(*gen)
+        cc.prepare(*gen)
+        for s in range(6):
+            nr.run_step(s)
+            cc.run_step(s)
+        nr.block()
+        cc.block()
+        assert nr.replicas_equal()
+        a, b = nr.state_dump(0), cc.state_dump()
+        np.testing.assert_array_equal(a["values"], b["values"])
+        np.testing.assert_array_equal(a["present"], b["present"])
+
+    def test_partitioned_applies_own_batch_only(self):
+        spec = WorkloadSpec(keyspace=32, seed=5)
+        gen = generate_batches(spec, 2, 2, 3, 1)
+        pr = PartitionedRunner(make_hashmap(32), 2, 3, 1)
+        pr.prepare(*gen)
+        for s in range(2):
+            pr.run_step(s)
+        pr.block()
+        wr_args = np.asarray(gen[1])
+        own_keys = set(wr_args[:, 0, :, 0].reshape(-1).tolist())
+        st0 = pr.state_dump(0)
+        present_keys = set(np.nonzero(st0["present"])[0].tolist())
+        assert present_keys == {k % 32 for k in own_keys}
+
+    def test_multilog_runner_runs_and_converges(self):
+        spec = WorkloadSpec(keyspace=64, seed=7)
+        gen = generate_batches(spec, 4, 2, 4, 2)
+        ml = MultiLogRunner(make_hashmap(64), 2, 4, 2, 2)
+        ml.prepare(*gen)
+        for s in range(4):
+            ml.run_step(s)
+        ml.block()
+        # all logs advanced equally; replicas converged
+        assert list(np.asarray(ml.ml.tail)) == [4 * 2] * 4
+        sa = ml.state_dump(0)
+        sb = ml.state_dump(1)
+        np.testing.assert_array_equal(sa["values"], sb["values"])
+
+    def test_multilog_rekey_respects_congruence(self):
+        spec = WorkloadSpec(keyspace=64, seed=9)
+        gen = generate_batches(spec, 2, 2, 4, 1)
+        ml = MultiLogRunner(make_hashmap(64), 2, 4, 2, 1)
+        ml.prepare(*gen)
+        args = np.asarray(ml._w[1])
+        for log in range(4):
+            assert np.all(args[:, log, :, 0] % 4 == log)
+
+
+class TestSweepAndCsv:
+    def test_scalebench_sweep_writes_csv(self, tmp_path):
+        res = (
+            ScaleBenchBuilder(
+                lambda: make_hashmap(64), "t", WorkloadSpec(keyspace=64)
+            )
+            .replicas([2, 4])
+            .log_strategies([1])
+            .batches([8])
+            .systems(["nr", "partitioned"])
+            .duration(0.1)
+            .out_dir(str(tmp_path))
+            .run()
+        )
+        assert len(res) == 4  # 2 replica counts x 2 systems
+        path = tmp_path / "scaleout_benchmarks.csv"
+        assert path.exists()
+        with open(path) as f:
+            rows = list(csv.DictReader(f))
+        assert {r["rs"] for r in rows} == {"2", "4"}
+        assert all(int(r["ops"]) > 0 for r in rows)
+
+    def test_baseline_comparison_writes_csv(self, tmp_path):
+        res = baseline_comparison(
+            lambda: make_hashmap(64),
+            "hm",
+            WorkloadSpec(keyspace=64),
+            batch_sizes=[8],
+            duration_s=0.1,
+            out_dir=str(tmp_path),
+        )
+        assert len(res) == 2
+        assert (tmp_path / "baseline_comparison.csv").exists()
+        names = {r.name for r in res}
+        assert names == {"hm-direct", "hm-log"}
+
+    def test_cnr_sweep_runs(self, tmp_path):
+        res = (
+            ScaleBenchBuilder(
+                lambda: make_hashmap(64), "t2", WorkloadSpec(keyspace=64)
+            )
+            .replicas([2])
+            .log_strategies([2])
+            .batches([8])
+            .systems(["cnr"])
+            .duration(0.1)
+            .out_dir(str(tmp_path))
+            .run()
+        )
+        assert len(res) == 1 and res[0].total_dispatches > 0

@@ -63,6 +63,12 @@ class TestEngineBasics:
             NativeEngine(MODEL_STACK, 8, n_replicas=1, nlogs=2)
         with pytest.raises(ValueError):
             NativeEngine(0, 8, n_replicas=1)
+        # zero/negative model size would div-by-zero in dispatch
+        with pytest.raises(ValueError):
+            NativeEngine(MODEL_HASHMAP, 0, n_replicas=1)
+        # a log too small to ever fit one combiner batch under GC slack
+        with pytest.raises(ValueError):
+            NativeEngine(MODEL_HASHMAP, 16, n_replicas=1, log_capacity=32)
 
     def test_cursor_telemetry(self):
         with NativeEngine(MODEL_HASHMAP, 16, n_replicas=2) as e:
@@ -84,31 +90,33 @@ class TestEngineBasics:
 
 class TestLogWrap:
     def test_wraparound_and_gc(self):
-        # log capacity 1<<8=256, slack=64; push 10 laps of ops through
+        # log capacity 1024, slack=256; push 10 laps of ops through
         with NativeEngine(
-            MODEL_HASHMAP, 32, n_replicas=1, log_capacity=256
+            MODEL_HASHMAP, 32, n_replicas=1, log_capacity=1024
         ) as e:
             t = e.register(0)
-            for i in range(2560 // 32):
+            total = 10 * 1024
+            for i in range(total // 32):
                 e.execute_mut_batch(
                     [(1, (i * 32 + j) % 32, i) for j in range(32)], t
                 )
-            assert e.log_tail() == 2560
+            assert e.log_tail() == total
             assert e.log_head() > 0  # GC advanced
-            lap = 2560 // 32 - 1
+            lap = total // 32 - 1
             assert all(e.state_dump(0)[:32] == lap)
 
     def test_stuck_counter_fires_on_dormant_replica(self):
         # Replica 1 never syncs; appender must help-and-wait, bumping the
         # starvation counter (the CNR gc-callback capability,
         # `cnr/src/log.rs:505-515`), until replica 1 is synced.
-        e = NativeEngine(MODEL_HASHMAP, 16, n_replicas=2, log_capacity=256)
+        e = NativeEngine(MODEL_HASHMAP, 16, n_replicas=2, log_capacity=1024)
         t0 = e.register(0)
         done = threading.Event()
 
         def appender():
-            for i in range(300 // 25):
-                e.execute_mut_batch([(1, j % 16, i) for j in range(25)], t0)
+            # 2048 ops > capacity: must block on the dormant replica
+            for i in range(2048 // 32):
+                e.execute_mut_batch([(1, j % 16, i) for j in range(32)], t0)
             done.set()
 
         th = threading.Thread(target=appender, daemon=True)
@@ -193,6 +201,27 @@ class TestConcurrency:
                 counts = [v >> 8 for v in vals if (v & 0xFF) == g]
                 assert counts == sorted(counts)
                 assert len(counts) == OPS
+
+    def test_cnr_aliasing_keys_share_a_log(self):
+        # Raw keys 5 and 15 alias the same cell when n_keys=10; the native
+        # LogMapper must canonicalize (mod n_keys) before % nlogs or the
+        # conflicting ops replay in different orders per replica.
+        for trial in range(5):
+            with NativeEngine(
+                MODEL_HASHMAP, 10, n_replicas=2, nlogs=3
+            ) as e:
+
+                def worker(rid, key, val):
+                    tok = e.register(rid)
+                    for i in range(200):
+                        e.execute_mut((1, key, val + i), tok)
+
+                a = threading.Thread(target=worker, args=(0, 5, 1000))
+                b = threading.Thread(target=worker, args=(1, 15, 5000))
+                a.start(), b.start()
+                a.join(), b.join()
+                e.sync()
+                assert e.replicas_equal(), f"diverged on trial {trial}"
 
     def test_cnr_multilog_concurrent(self):
         R, T, OPS, L = 2, 4, 300, 4
