@@ -39,6 +39,10 @@ def main():
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--pallas", action="store_true",
+                   help="hand-tiled Pallas replay kernel instead of the "
+                        "generic vmapped-scan path; VMEM-bound, needs a "
+                        "small keyspace (e.g. --keys 1024)")
     args = p.parse_args()
 
     R, Bw, Br = args.replicas, args.writes_per_replica, args.reads_per_replica
@@ -50,9 +54,21 @@ def main():
         gc_slack=min(8192, span),
     )
     d = make_hashmap(args.keys)
-    step = make_step(d, spec, Bw, Br)
     log = log_init(spec)
-    states = replicate_state(d.init_state(), R)
+    if args.pallas:
+        from node_replication_tpu.ops.pallas_replay import (
+            make_pallas_step,
+            pallas_hashmap_state,
+        )
+
+        try:
+            step = make_pallas_step(args.keys, spec, Bw, Br)
+        except ValueError as e:
+            sys.exit(f"--pallas config rejected: {e}")
+        states = pallas_hashmap_state(args.keys, R)
+    else:
+        step = make_step(d, spec, Bw, Br)
+        states = replicate_state(d.init_state(), R)
 
     T = args.steps + args.warmup
 

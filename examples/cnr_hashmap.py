@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""CNR hashmap example (`cnr/examples/hashmap.rs` parity).
+
+The multi-log variant: ops partition over 4 logs by key (the LogMapper
+contract — equal keys conflict and share a log, distinct keys commute,
+`cnr/src/lib.rs:123-137`), replayed through the fused multi-log step.
+
+Run: python examples/cnr_hashmap.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from node_replication_tpu.core.multilog import (
+    MultiLogSpec,
+    make_multilog_step,
+    multilog_init,
+    partition_ops,
+)
+from node_replication_tpu.core.replica import replicate_state
+from node_replication_tpu.models import HM_GET, HM_PUT, make_hashmap
+from node_replication_tpu.ops.encoding import encode_ops
+
+NLOGS, REPLICAS, KEYS = 4, 2, 256
+
+
+def main():
+    d = make_hashmap(KEYS)
+    spec = MultiLogSpec(nlogs=NLOGS, capacity=1 << 10, n_replicas=REPLICAS,
+                        gc_slack=32)
+    step = make_multilog_step(d, spec, writes_per_log=8, reads_per_replica=4)
+    ml = multilog_init(spec)
+    states = replicate_state(d.init_state(), REPLICAS)
+
+    # 32 puts partitioned over the 4 logs by key (the LogMapper)
+    ops = [(HM_PUT, (k, 100 + k)) for k in range(32)]
+    opc, args, counts, placements = partition_ops(
+        lambda opcode, a: a[0], NLOGS, ops, d.arg_width, pad_to=8
+    )
+    rd_opc, rd_args, _ = encode_ops(
+        [(HM_GET, k) for k in range(4)], d.arg_width
+    )
+    ml, states, wr_resps, rd_resps = step(
+        ml, states,
+        opc, args, counts,
+        np.broadcast_to(np.asarray(rd_opc), (REPLICAS, 4)),
+        np.broadcast_to(np.asarray(rd_args), (REPLICAS, 4, d.arg_width)),
+    )
+    assert list(np.asarray(ml.tail)) == [8] * NLOGS
+    assert np.asarray(rd_resps).tolist() == [[100, 101, 102, 103]] * REPLICAS
+    print(f"cnr_hashmap OK: 32 puts over {NLOGS} logs, "
+          f"per-log tails={list(np.asarray(ml.tail))}, reads consistent")
+
+
+if __name__ == "__main__":
+    main()

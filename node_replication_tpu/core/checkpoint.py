@@ -1,0 +1,141 @@
+"""Checkpoint/resume and recovery-by-replay.
+
+The reference has no checkpoint subsystem; its recovery model is structural
+(SURVEY.md §5): replica state is reconstructable from a deterministic
+`Default` by replaying the log from head — `Log::reset` exists only for
+bench reuse (`nr/src/log.rs:582-611`) and `D: Default` is required
+precisely so replay-from-scratch is well-defined
+(`nr/examples/stack.rs:30-35`). This module makes both halves first-class
+for the TPU build, where jobs are preempted routinely:
+
+- `save_snapshot` / `load_snapshot` — durable npz snapshots of the log ring
+  + cursors + replica states (numpy container: dependency-free and
+  readable anywhere; swap in orbax for sharded async checkpoints when the
+  fleet outgrows one host).
+- `recover_states` — the reference's recovery model, compiled: start every
+  replica from `init_state()` (or a snapshot taken at a known position)
+  and replay `[base_pos, tail)` through the same vmapped scan used for
+  live replay. Determinism of `Dispatch` transitions makes the result
+  bit-identical to the lost states.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from node_replication_tpu.core.log import (
+    LogSpec,
+    LogState,
+    log_exec_all,
+)
+from node_replication_tpu.core.replica import replicate_state
+from node_replication_tpu.ops.encoding import Dispatch
+
+PyTree = Any
+
+_SPEC_FIELDS = ("capacity", "n_replicas", "arg_width", "gc_slack")
+
+
+def save_snapshot(path: str, spec: LogSpec, log: LogState,
+                  states: PyTree) -> None:
+    """Write a durable snapshot: spec + log ring/cursors + replica states.
+
+    States may be any pytree of arrays; the tree structure is rebuilt at
+    load from the flattened leaf order plus the treedef of the caller's
+    template, so save/load pairs must use the same Dispatch.
+    """
+    leaves, _ = jax.tree.flatten(states)
+    payload = {
+        "spec": np.asarray([getattr(spec, f) for f in _SPEC_FIELDS],
+                           np.int64),
+        "log_opcodes": np.asarray(log.opcodes),
+        "log_args": np.asarray(log.args),
+        "log_head": np.asarray(log.head),
+        "log_tail": np.asarray(log.tail),
+        "log_ctail": np.asarray(log.ctail),
+        "log_ltails": np.asarray(log.ltails),
+        "n_state_leaves": np.int64(len(leaves)),
+    }
+    for i, leaf in enumerate(leaves):
+        payload[f"state_{i}"] = np.asarray(leaf)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)
+
+
+def peek_spec(path: str) -> LogSpec:
+    """Read only the LogSpec from a snapshot (owns the `_SPEC_FIELDS`
+    encoding, so callers never index the raw array)."""
+    with np.load(path) as z:
+        return LogSpec(
+            **dict(zip(_SPEC_FIELDS, (int(v) for v in z["spec"])))
+        )
+
+
+def load_snapshot(path: str, states_template: PyTree
+                  ) -> tuple[LogSpec, LogState, PyTree]:
+    """Load a snapshot; `states_template` supplies the pytree structure
+    (e.g. `replicate_state(d.init_state(), R)`)."""
+    with np.load(path) as z:
+        spec = LogSpec(**dict(zip(_SPEC_FIELDS,
+                                  (int(v) for v in z["spec"]))))
+        log = LogState(
+            opcodes=jnp.asarray(z["log_opcodes"]),
+            args=jnp.asarray(z["log_args"]),
+            head=jnp.asarray(z["log_head"]),
+            tail=jnp.asarray(z["log_tail"]),
+            ctail=jnp.asarray(z["log_ctail"]),
+            ltails=jnp.asarray(z["log_ltails"]),
+        )
+        n = int(z["n_state_leaves"])
+        leaves = [jnp.asarray(z[f"state_{i}"]) for i in range(n)]
+    treedef = jax.tree.structure(states_template)
+    return spec, log, jax.tree.unflatten(treedef, leaves)
+
+
+def recover_states(
+    dispatch: Dispatch,
+    spec: LogSpec,
+    log: LogState,
+    base_states: PyTree | None = None,
+    base_pos: int | None = None,
+    window: int = 256,
+) -> tuple[LogState, PyTree]:
+    """Rebuild replica states by replaying the log (the recovery model).
+
+    `base_states`/`base_pos` resume from a snapshot taken at logical
+    position `base_pos`. By default recovery starts from `init_state()` at
+    position 0 — valid while the ring still physically holds every entry
+    of `[0, tail)`, i.e. `tail <= capacity` (GC moves `head` logically but
+    only a wrap overwrites slots). Past that point a base snapshot is
+    required. Returns `(log, states)` with every `ltails[r]` = tail.
+    """
+    if base_states is None:
+        base_states = replicate_state(
+            dispatch.init_state(), spec.n_replicas
+        )
+    start = 0 if base_pos is None else int(base_pos)
+    if int(log.tail) - start > spec.capacity:
+        raise ValueError(
+            f"entries [{start}, {int(log.tail) - spec.capacity}) have been "
+            f"overwritten by ring wrap; recovery needs a base snapshot at "
+            f"position >= {int(log.tail) - spec.capacity}"
+        )
+    log = log._replace(
+        ltails=jnp.full((spec.n_replicas,), start, jnp.int64)
+    )
+    exec_jit = jax.jit(
+        lambda lg, st: log_exec_all(spec, dispatch, lg, st, window)
+    )
+    states = base_states
+    while int(jnp.min(log.ltails)) < int(log.tail):
+        log, states, _ = exec_jit(log, states)
+    return log, states

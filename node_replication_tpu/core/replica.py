@@ -128,6 +128,7 @@ class NodeReplicated:
         self._threads_per_replica = [0] * n_replicas
         # Appended-but-unanswered ops per replica: deque[(logical_pos, tid)].
         self._inflight: list[deque] = [deque() for _ in range(n_replicas)]
+        self._exec_rounds = 0
 
         self._exec_jit = jax.jit(
             partial(log_exec_all, self.spec, dispatch),
@@ -282,6 +283,60 @@ class NodeReplicated:
             self._exec_round()
             rounds = self._watchdog(rounds, "sync")
 
+    def checkpoint(self, path: str) -> None:
+        """Durable snapshot of log + all replica states (see
+        `core/checkpoint.py`; the recovery model is deterministic-init +
+        replay, SURVEY.md §5)."""
+        from node_replication_tpu.core.checkpoint import save_snapshot
+
+        save_snapshot(path, self.spec, self.log, self.states)
+
+    @classmethod
+    def restore(cls, path: str, dispatch: Dispatch,
+                **kwargs) -> "NodeReplicated":
+        """Rebuild a NodeReplicated from a snapshot. Thread registrations
+        are not part of a snapshot (tokens are process-local, like the
+        reference's !Send ReplicaToken); re-register after restore."""
+        from node_replication_tpu.core.checkpoint import (
+            load_snapshot,
+            peek_spec,
+        )
+
+        spec = peek_spec(path)
+        nr = cls(dispatch, n_replicas=spec.n_replicas,
+                 log_entries=spec.capacity, gc_slack=spec.gc_slack,
+                 **kwargs)
+        _, nr.log, nr.states = load_snapshot(path, nr.states)
+        return nr
+
+    def recover(self, base_states=None, base_pos: int | None = None) -> None:
+        """Discard replica states and rebuild them by replay
+        (deterministic-init + replay — the reference's recovery model,
+        SURVEY.md §5). Without a base, replay starts at position 0, which
+        requires `tail <= capacity` (no slot overwritten yet); a
+        long-running instance passes `base_states`/`base_pos` from a
+        `checkpoint()` snapshot instead. In-flight responses are lost,
+        matching a crash."""
+        from node_replication_tpu.core.checkpoint import recover_states
+
+        self.log, self.states = recover_states(
+            self.dispatch, self.spec, self.log,
+            base_states=base_states, base_pos=base_pos,
+            window=self.exec_window,
+        )
+        self._inflight = [deque() for _ in range(self.n_replicas)]
+
+    def stats(self) -> dict:
+        """Observability counters (the harness's per-second ops capture is
+        the reference's profiling story, `benches/mkbench.rs:755-761`)."""
+        return {
+            "appended": int(self.log.tail),
+            "head": int(self.log.head),
+            "ctail": int(self.log.ctail),
+            "min_ltail": int(np.min(np.asarray(self.log.ltails))),
+            "exec_rounds": self._exec_rounds,
+        }
+
     def verify(self, fn: Callable[[Any], Any], rid: int = 0):
         """Test hook (`Replica::verify`, `nr/src/replica.rs:443-467`):
         force-sync, then expose replica `rid`'s state (as host numpy pytree)
@@ -307,6 +362,7 @@ class NodeReplicated:
         """One static-window replay round for every replica, plus response
         distribution. Returns True if any replica made progress."""
         ltails_before = np.asarray(self.log.ltails).copy()
+        self._exec_rounds += 1
         self.log, self.states, resps = self._exec_jit(
             self.log, self.states, window=self.exec_window
         )

@@ -1,0 +1,236 @@
+"""Pallas TPU kernel for the hashmap replay hot loop.
+
+The generic replay path (`core/log.log_exec_all`) is a vmapped `lax.scan`
+whose every iteration scatters one element per replica into HBM-resident
+state. This kernel is the hand-tiled alternative for the flagship hashmap
+model (SURVEY.md §7: "Pallas kernels for the append/reserve and
+scan-replay inner loops if XLA fusion falls short"):
+
+- state is laid out TRANSPOSED, `[K, R]`: keys on the sublane axis,
+  replicas on the 128-wide lane axis. Replay touches one dynamic KEY per
+  entry but all replicas at once — on TPU the dynamically-indexed axis
+  must be the sublane one (Mosaic has no dynamic lane indexing), and the
+  replica axis is naturally lane-parallel;
+- the replica axis is tiled into VMEM blocks (`[Kp, tile_r]`, ~16 MB/core
+  budget); each entry is a dynamic single-ROW read-modify-write IN VMEM
+  (`ref[pl.ds(k, 1), :]`), so the inner loop never round-trips HBM;
+- per-tile state is written back exactly once.
+
+All replicas replay the same window at the same offsets (the lock-step
+precondition of the fused step), so one kernel grid covers the fleet.
+
+Opcodes follow `models/hashmap.py`: PUT=1 (k, v → 0), REMOVE=2 (k → was
+present). `present` is int32 here (lane-friendly); `make_pallas_step`
+exposes the same step contract as `core/step.make_step` over the
+transposed state (`pallas_hashmap_state`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from node_replication_tpu.core.log import LogSpec, log_append
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _replay_kernel(opc_ref, key_ref, val_ref, val_in, pres_in, val_out,
+                   pres_out, resp_ref, *, n_keys: int, window: int):
+    # load the tile's state into the output VMEM blocks once
+    val_out[:] = val_in[:]
+    pres_out[:] = pres_in[:]
+
+    def body(i, carry):
+        # opcode/key/value live in SMEM: Mosaic requires dynamic-slice
+        # indices to come from scalar memory, not VMEM loads
+        opcode = opc_ref[i]
+        k = jax.lax.rem(key_ref[i], jnp.int32(n_keys))
+        v = val_ref[i]
+        is_put = opcode == 1
+        is_rem = opcode == 2
+        row_v = val_out[pl.ds(k, 1), :]
+        row_p = pres_out[pl.ds(k, 1), :]
+        val_out[pl.ds(k, 1), :] = jnp.where(
+            is_put, v, jnp.where(is_rem, 0, row_v)
+        )
+        pres_out[pl.ds(k, 1), :] = jnp.where(
+            is_put, 1, jnp.where(is_rem, 0, row_p)
+        )
+        resp_ref[pl.ds(i, 1), :] = jnp.where(is_rem, row_p, 0)
+        return carry
+
+    # int32 loop bounds: under jax_enable_x64 a Python-int fori_loop index
+    # becomes int64, which Mosaic cannot lower
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(window), body, jnp.int32(0))
+
+
+def make_hashmap_replay(
+    n_keys: int,
+    n_replicas: int,
+    window: int,
+    tile_r: int = 512,
+    interpret: bool = False,
+):
+    """Build `replay(opcodes[W], keys[W], vals[W], values[Kp, R],
+    present[Kp, R]) -> (values, present, resps[W, R])` with Kp = n_keys
+    padded to the 8-sublane boundary. Window entries replay in order into
+    every replica.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+    kp = _round_up(n_keys, 8)
+    # lane (last) dim of a block must be a multiple of 128 or the full
+    # array dim; sublane dims of the state blocks are full (Kp, W). The
+    # four state blocks (values/present × in/out) plus the resp block must
+    # fit the ~16 MB VMEM: shrink the replica tile until they do.
+    budget = 14 << 20
+
+    def block_bytes(t: int) -> int:
+        # x2: Mosaic double-buffers every DMA'd block for grid pipelining
+        return 2 * 4 * (4 * kp * t + window * t)
+
+    candidates = [t for t in (1024, 512, 256, 128)
+                  if n_replicas % t == 0] or [n_replicas]
+    for t in candidates:
+        if (n_replicas % tile_r == 0
+                and (tile_r % 128 == 0 or tile_r == n_replicas)
+                and block_bytes(tile_r) <= budget):
+            break  # caller's tile is legal and fits
+        tile_r = t
+        if block_bytes(t) <= budget:
+            break
+    if block_bytes(tile_r) > budget and not interpret:
+        raise ValueError(
+            f"hashmap pallas replay needs {block_bytes(tile_r)} bytes of "
+            f"VMEM at the smallest legal tile ({tile_r} replicas) for "
+            f"n_keys={n_keys}, window={window}; use the generic scan path "
+            f"(core/step.make_step) for this config"
+        )
+    grid = (n_replicas // tile_r,)
+    kernel = functools.partial(
+        _replay_kernel, n_keys=n_keys, window=window
+    )
+    state_spec = pl.BlockSpec((kp, tile_r), lambda i: (0, i))
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            state_spec,
+            state_spec,
+        ],
+        out_specs=[
+            state_spec,
+            state_spec,
+            pl.BlockSpec((window, tile_r), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((kp, n_replicas), jnp.int32),
+            jax.ShapeDtypeStruct((kp, n_replicas), jnp.int32),
+            jax.ShapeDtypeStruct((window, n_replicas), jnp.int32),
+        ],
+        interpret=interpret,
+    )
+
+    def replay(opcodes, keys, vals, values, present):
+        # trace the kernel with x64 off: the package enables jax_enable_x64
+        # for int64 log cursors, but x64-canonicalized index-map constants
+        # (i64) send the Mosaic lowering into an unsupported-convert loop.
+        # Every kernel operand is int32, so the narrowing context is inert.
+        with jax.enable_x64(False):
+            return call(opcodes, keys, vals, values, present)
+
+    return replay
+
+
+def make_pallas_step(
+    n_keys: int,
+    spec: LogSpec,
+    writes_per_replica: int,
+    reads_per_replica: int,
+    tile_r: int = 512,
+    interpret: bool = False,
+    jit: bool = True,
+    donate: bool = True,
+):
+    """Pallas twin of `core/step.make_step` for the hashmap model.
+
+    Same contract: append the fleet's write batch to the ring, replay it
+    into every replica (via the kernel), answer reads locally. State is
+    `{"values": int32[Kp, R], "present": int32[Kp, R]}` (transposed) —
+    create it with `pallas_hashmap_state(n_keys, R)`.
+    """
+    R = spec.n_replicas
+    Bw = int(writes_per_replica)
+    span = R * Bw
+    # replay in window chunks: a smaller kernel window frees VMEM for the
+    # state blocks (the chunks apply strictly in order, so semantics hold)
+    chunk = span
+    while chunk > 1024 and chunk % 2 == 0:
+        chunk //= 2
+    replay = make_hashmap_replay(
+        n_keys, R, chunk, tile_r=tile_r, interpret=interpret
+    )
+
+    def step(log, states, wr_opcodes, wr_args, rd_opcodes, rd_args):
+        opc = wr_opcodes.reshape(span)
+        args = wr_args.reshape(span, spec.arg_width)
+        log = log_append(spec, log, opc, args, span)
+        values, present = states["values"], states["present"]
+        resp_chunks = []
+        for c0 in range(0, span, chunk):
+            values, present, r = replay(
+                opc[c0 : c0 + chunk],
+                args[c0 : c0 + chunk, 0],
+                args[c0 : c0 + chunk, 1],
+                values,
+                present,
+            )
+            resp_chunks.append(r)
+        resps = (
+            jnp.concatenate(resp_chunks, axis=0)
+            if len(resp_chunks) > 1
+            else resp_chunks[0]
+        )
+        states = {"values": values, "present": present}
+        # cursors advance in lock-step (every replica replayed the span)
+        log = log._replace(
+            ltails=log.ltails + span,
+            ctail=log.ctail + span,
+            head=log.head + span,
+        )
+        # resps is [W, R]; replica r's own writes are entries
+        # [r*Bw, (r+1)*Bw)
+        own = jnp.arange(R, dtype=jnp.int32)[:, None] * Bw + jnp.arange(
+            Bw, dtype=jnp.int32
+        )[None, :]  # [R, Bw]
+        wr_resps = resps[own, jnp.arange(R, dtype=jnp.int32)[:, None]]
+        # reads: gather values[k, r] per (replica, read slot)
+        k = rd_args[..., 0] % n_keys  # [R, Br]
+        r_idx = jnp.arange(R, dtype=jnp.int32)[:, None]
+        vals = values[k, r_idx]
+        pres = present[k, r_idx]
+        rd_resps = jnp.where(
+            (rd_opcodes == 1) & (pres > 0), vals, jnp.int32(-1)
+        )
+        rd_resps = jnp.where(rd_opcodes == 0, 0, rd_resps)
+        return log, states, wr_resps, rd_resps
+
+    if jit:
+        step = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    return step
+
+
+def pallas_hashmap_state(n_keys: int, n_replicas: int):
+    kp = _round_up(n_keys, 8)
+    return {
+        "values": jnp.zeros((kp, n_replicas), jnp.int32),
+        "present": jnp.zeros((kp, n_replicas), jnp.int32),
+    }

@@ -1,0 +1,187 @@
+"""Explicit-collective multi-chip replay: shard_map + ICI primitives.
+
+`parallel/mesh.py` scales by annotation (GSPMD inserts the collectives);
+this module is the hand-scheduled path for the two places where owning the
+communication pattern matters (SURVEY.md §2.6 "TPU-native equivalent"):
+
+1. `make_shmap_step` — the fused append→replay→read step as a `shard_map`
+   program: each chip generates its replica shard's write batch, the full
+   append span is assembled with an explicit `all_gather` over the ICI
+   ring (the moral equivalent of the reference's cross-replica entry
+   publication, `nr/src/log.rs:391-418`), every chip appends the identical
+   span to its local log copy, and replays only its shard. `ctail`/`head`
+   bookkeeping uses `pmax`/`pmin` over the mesh axis — `fetch_max` /
+   `min(ltails)` (`nr/src/log.rs:520-523`, `536-580`) as lattice
+   reductions over ICI.
+
+2. `make_ring_exec` — sequence parallelism for the op stream: a LONG
+   replay window (W entries) is sharded over P chips; chunks rotate around
+   the ICI ring (`ppermute`, ring-attention style) while replica-state
+   shards stay resident. Unlike attention, log replay does NOT commute
+   across chunks, so each chip masks its activity window to consume chunks
+   in order: chip d sees chunk `(d + t) % P` at round t and is active for
+   `t ∈ [P-d, 2P-d-1]` — a software pipeline whose fill/drain bubbles are
+   masked NOOP replays (padded slots replay as identity, so masking is
+   free of control flow). After `2P-1` rounds every replica shard has
+   applied all W entries in log order.
+
+   This is the structural analog of CNR's "scale the stream" story
+   (SURVEY.md §5 long-context): one logical op stream, sharded transport,
+   per-shard compute, order restored by schedule rather than by lock.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from node_replication_tpu.core.log import LogSpec, LogState, _exec_one
+from node_replication_tpu.ops.encoding import (
+    Dispatch,
+    NOOP,
+    apply_write,
+    dispatch_reads,
+)
+
+
+def make_shmap_step(
+    dispatch: Dispatch,
+    spec: LogSpec,
+    mesh: Mesh,
+    writes_per_replica: int,
+    reads_per_replica: int,
+    axis: str = "replica",
+):
+    """Explicit-collective twin of `core/step.make_step`.
+
+    Shapes are the global ones (`[R, Bw]` etc.); states and batches shard
+    over `axis`, the log replicates. Requires `R % mesh.shape[axis] == 0`.
+    Returns a jitted step with the same signature/results as `make_step`.
+    """
+    R = spec.n_replicas
+    Bw = int(writes_per_replica)
+    nshards = mesh.shape[axis]
+    if R % nshards:
+        raise ValueError(f"R={R} not divisible by {nshards} shards")
+    Rl = R // nshards
+    span = R * Bw
+
+    def local(log, states_l, wr_opc_l, wr_args_l, rd_opc_l, rd_args_l):
+        # [Rl, Bw] local batches → [R*Bw] global span over the ICI ring.
+        opc = lax.all_gather(wr_opc_l, axis, tiled=True).reshape(span)
+        args = lax.all_gather(wr_args_l, axis, tiled=True).reshape(
+            span, spec.arg_width
+        )
+        # every chip appends the identical span to its local log copy
+        lanes = jnp.arange(span, dtype=jnp.int64)
+        slot = ((log.tail + lanes) & spec.mask).astype(jnp.int32)
+        log = log._replace(
+            opcodes=log.opcodes.at[slot].set(opc),
+            args=log.args.at[slot].set(args),
+            tail=log.tail + span,
+        )
+        # replay the appended window into the local replica shard only
+        states_l, resps_l, new_ltails_l = jax.vmap(
+            lambda s, lt: _exec_one(spec, dispatch, log, s, lt, span)
+        )(states_l, log.ltails)
+        # lattice bookkeeping over the mesh axis: fetch_max(ctail),
+        # min(ltails) GC — pmax/pmin ride ICI
+        local_max = jnp.max(new_ltails_l)
+        local_min = jnp.min(new_ltails_l)
+        log = log._replace(
+            ltails=new_ltails_l,
+            ctail=jnp.maximum(log.ctail, lax.pmax(local_max, axis)),
+            head=lax.pmin(local_min, axis),
+        )
+        # own responses: local replica r sits at global index
+        # didx*Rl + r; its writes occupy window offsets [g*Bw, (g+1)*Bw)
+        didx = lax.axis_index(axis)
+        g = didx * Rl + jnp.arange(Rl, dtype=jnp.int32)[:, None]
+        own = g * Bw + jnp.arange(Bw, dtype=jnp.int32)[None, :]
+        wr_resps_l = jnp.take_along_axis(resps_l, own, axis=1)
+        rd_resps_l = dispatch_reads(dispatch, states_l, rd_opc_l, rd_args_l)
+        return log, states_l, wr_resps_l, rd_resps_l
+
+    shardy = P(axis)
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            LogState(opcodes=P(), args=P(), head=P(), tail=P(), ctail=P(),
+                     ltails=shardy),
+            jax.tree.map(lambda _: shardy, dispatch.init_state()),
+            shardy, shardy, shardy, shardy,
+        ),
+        out_specs=(
+            LogState(opcodes=P(), args=P(), head=P(), tail=P(), ctail=P(),
+                     ltails=shardy),
+            jax.tree.map(lambda _: shardy, dispatch.init_state()),
+            shardy, shardy,
+        ),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def make_ring_exec(
+    dispatch: Dispatch,
+    mesh: Mesh,
+    axis: str = "replica",
+):
+    """Pipelined ring replay of a long, device-sharded op window.
+
+    `ring_exec(opcodes, args, states)`:
+      opcodes int32[W], args int32[W, A]  — sharded over `axis` in P chunks
+      states  [R, ...] pytree            — replica shards over `axis`
+
+    Every replica applies all W entries in log order; chunks move over ICI
+    (`ppermute`), states stay resident. W and R must divide by P.
+    Returns the updated states.
+    """
+    nshards = mesh.shape[axis]
+
+    def apply_chunk(states_l, opc_l, args_l):
+        def per_replica(state):
+            def body(st, x):
+                o, a = x
+                st, _ = apply_write(dispatch, st, o, a)
+                return st, jnp.int32(0)
+
+            st, _ = lax.scan(body, state, (opc_l, args_l))
+            return st
+
+        return jax.vmap(per_replica)(states_l)
+
+    def local(opc_l, args_l, states_l):
+        didx = lax.axis_index(axis)
+        # chunks rotate backward: chunk c sits on chip (c - t) % P at
+        # round t, so chip d hosts chunk (d + t) % P
+        perm = [(i, (i - 1) % nshards) for i in range(nshards)]
+        opc, args = opc_l, args_l
+        states = states_l
+        for t in range(1, 2 * nshards):
+            opc = lax.ppermute(opc, axis, perm)
+            args = lax.ppermute(args, axis, perm)
+            # ordered consumption: chip d applies chunks 0..P-1 during
+            # rounds [P-d, 2P-d-1]; outside the window the chunk is
+            # masked to NOOP (identity replay) — pipeline bubbles as
+            # masked compute, no control flow
+            active = (t >= nshards - didx) & (t <= 2 * nshards - didx - 1)
+            masked = jnp.where(active, opc, jnp.int32(NOOP))
+            states = apply_chunk(states, masked, args)
+        return states
+
+    shardy = P(axis)
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(shardy, shardy,
+                  jax.tree.map(lambda _: shardy, dispatch.init_state())),
+        out_specs=jax.tree.map(lambda _: shardy, dispatch.init_state()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
