@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Render throughput graphs from the harness CSVs.
+
+The analog of the reference's R/ggplot scripts
+(`benches/hashbench_plot.r`) and its published throughput-vs-cores panels
+(`benches/graphs/skylake4x-throughput-vs-cores.png`): one panel per
+workload name, aggregate Mops vs replica count, one line per system/log
+strategy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+from collections import defaultdict
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--csv", default="scaleout_benchmarks.csv")
+    p.add_argument("--out", default=".")
+    args = p.parse_args()
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    # rows: name, rs (replicas), ls, tm, batch, threads, duration,
+    # thread_id, core_id, second, ops. Each row is a per-second bucket;
+    # summing each row's own wall-clock coverage keeps the average honest
+    # even when the CSV holds multiple appended runs.
+    agg: dict = defaultdict(lambda: defaultdict(float))
+    dur: dict = defaultdict(lambda: defaultdict(float))
+    with open(args.csv) as f:
+        for row in csv.DictReader(f):
+            key = (row["name"], int(row["ls"]), int(row["batch"]))
+            r = int(row["rs"])
+            agg[key][r] += int(row["ops"])
+            sec = int(row["second"])
+            covered = (
+                min(1.0, float(row["duration"]) - sec)
+                if sec >= 0
+                else float(row["duration"])
+            )
+            dur[key][r] += max(covered, 1e-9)
+
+    panels = sorted({k[0].split("/")[0] for k in agg})
+    fig, axes = plt.subplots(
+        len(panels), 1, figsize=(7, 3 * len(panels)), squeeze=False
+    )
+    for ax, panel in zip(axes[:, 0], panels):
+        for (name, ls, batch), by_r in sorted(agg.items()):
+            if not name.startswith(panel):
+                continue
+            rs = sorted(by_r)
+            mops = [
+                by_r[r] / dur[(name, ls, batch)][r] / 1e6 for r in rs
+            ]
+            label = name.split("/")[-1] + (f" logs={ls}" if ls > 1 else "")
+            ax.plot(rs, mops, marker="o", label=f"{label} b{batch}")
+        ax.set_title(panel)
+        ax.set_xlabel("replicas")
+        ax.set_ylabel("Mops (aggregate)")
+        ax.set_xscale("log", base=2)
+        ax.legend(fontsize=7)
+        ax.grid(alpha=0.3)
+    fig.tight_layout()
+    out = os.path.join(args.out, "throughput-vs-replicas.png")
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -5,6 +5,7 @@ set -euo pipefail
 cd "$(dirname "$0")"
 OUT=out
 mkdir -p "$OUT"
+rm -f "$OUT"/*.csv  # fresh run: the CSV writers append
 EXTRA=${FULL:+--full}
 DUR=${DUR:-1.0}
 

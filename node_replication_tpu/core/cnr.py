@@ -1,0 +1,313 @@
+"""`MultiLogReplicated`: the stateful CNR surface (the `cnr` crate's
+`Replica` API, per-op form).
+
+Mirrors `cnr/src/replica.rs`: every op is routed to a log by the user's
+`LogMapper` (`hash % nlogs`, `cnr/src/replica.rs:435`); writes stage in the
+issuing thread's context tagged with their log and combine per log
+(`cnr/src/replica.rs:673-720`); reads sync only their mapped log
+(`cnr/src/replica.rs:599-617`); `sync()` loops all logs and `sync_log`
+targets one (`cnr/src/replica.rs:579-597`). The per-log GC-starvation
+callback (`cnr/src/log.rs:135-142`) fires as `gc_callback(log_idx,
+dormant_replica)` from the host-side watchdog when a log's replay stalls.
+
+The jit-hot batch path is `core/multilog.make_multilog_step`; this wrapper
+is the per-op convenience with the same replay kernels underneath.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from node_replication_tpu.core.log import WARN_ROUNDS
+from node_replication_tpu.core.multilog import (
+    LogMapper,
+    MultiLogSpec,
+    _exec_one_log,
+    multilog_init,
+)
+from node_replication_tpu.core.replica import (
+    MAX_THREADS_PER_REPLICA,
+    ReplicaToken,
+    replicate_state,
+)
+from node_replication_tpu.ops.encoding import Dispatch, apply_read, encode_ops
+
+logger = logging.getLogger("node_replication_tpu")
+
+
+class MultiLogReplicated:
+    """N replicas of one `Dispatch` behind L commutativity-partitioned logs."""
+
+    def __init__(
+        self,
+        dispatch: Dispatch,
+        log_mapper: LogMapper,
+        nlogs: int,
+        n_replicas: int = 1,
+        log_entries: int = 1 << 12,
+        gc_slack: int = 128,
+        exec_window: int = 128,
+        gc_callback: Callable[[int, int], None] | None = None,
+    ):
+        self.spec = MultiLogSpec(
+            nlogs=nlogs,
+            capacity=log_entries,
+            n_replicas=n_replicas,
+            arg_width=dispatch.arg_width,
+            gc_slack=gc_slack,
+        )
+        self.dispatch = dispatch
+        self.log_mapper = log_mapper
+        self.exec_window = int(exec_window)
+        self.gc_callback = gc_callback
+
+        self.ml = multilog_init(self.spec)
+        self.states = replicate_state(dispatch.init_state(), n_replicas)
+
+        self._threads_per_replica = [0] * n_replicas
+        # staged ops: (rid, tid) -> deque[(log, opcode, args)]
+        self._pending: dict[tuple[int, int], deque] = {}
+        # appended-but-unanswered: (rid, log) -> deque[(pos, tid)]
+        self._inflight: dict[tuple[int, int], deque] = {}
+        # delivered responses per thread, in enqueue order per log
+        self._resps: dict[tuple[int, int], deque] = {}
+
+        spec, d = self.spec, dispatch
+
+        def exec_round(ml, states, log_idx: int, window: int):
+            states, resps, lt = jax.vmap(
+                lambda s, t: _exec_one_log(
+                    spec, d, ml.opcodes[log_idx], ml.args[log_idx],
+                    ml.tail[log_idx], s, t, window,
+                )
+            )(states, ml.ltails[log_idx])
+            ml = ml._replace(
+                ltails=ml.ltails.at[log_idx].set(lt),
+                ctail=ml.ctail.at[log_idx].set(
+                    jnp.maximum(ml.ctail[log_idx], jnp.max(lt))
+                ),
+                head=ml.head.at[log_idx].set(jnp.min(lt)),
+            )
+            return ml, states, resps
+
+        self._exec_jit = jax.jit(
+            exec_round, static_argnames=("log_idx", "window"),
+            donate_argnums=(0, 1),
+        )
+
+        def append_one(ml, log_idx: int, opcodes, args, count):
+            B = opcodes.shape[0]
+            lanes = jnp.arange(B, dtype=jnp.int64)
+            valid = lanes < count
+            slot = jnp.where(
+                valid, (ml.tail[log_idx] + lanes) & spec.mask, spec.capacity
+            ).astype(jnp.int32)
+            return ml._replace(
+                opcodes=ml.opcodes.at[log_idx, slot].set(
+                    opcodes, mode="drop"
+                ),
+                args=ml.args.at[log_idx, slot].set(args, mode="drop"),
+                tail=ml.tail.at[log_idx].add(count),
+            )
+
+        self._append_jit = jax.jit(
+            append_one, static_argnames=("log_idx",), donate_argnums=(0,)
+        )
+
+        def read_one(states, rid, opcode, args):
+            state = jax.tree.map(lambda a: a[rid], states)
+            return apply_read(d, state, opcode, args)
+
+        self._read_jit = jax.jit(read_one)
+
+    # ------------------------------------------------------------------ API
+
+    @property
+    def n_replicas(self) -> int:
+        return self.spec.n_replicas
+
+    @property
+    def nlogs(self) -> int:
+        return self.spec.nlogs
+
+    def register(self, rid: int = 0) -> ReplicaToken:
+        """Register a logical thread on replica `rid` — registration spans
+        every log, as `cnr`'s replica registers with each
+        (`cnr/src/replica.rs:209-281`)."""
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        tid = self._threads_per_replica[rid]
+        if tid >= MAX_THREADS_PER_REPLICA:
+            raise RuntimeError(f"replica {rid} thread limit reached")
+        self._threads_per_replica[rid] = tid + 1
+        self._pending[(rid, tid)] = deque()
+        self._resps[(rid, tid)] = deque()
+        return ReplicaToken(rid, tid)
+
+    def _map(self, op: tuple) -> int:
+        return self.log_mapper(op[0], tuple(op[1:])) % self.nlogs
+
+    def execute_mut(self, op: tuple, token: ReplicaToken):
+        """Route the write to its log, combine that log, return its
+        response (`cnr/src/replica.rs:430-445`)."""
+        h = self._map(op)
+        self._pending[(token.rid, token.tid)].append(
+            (h, op[0], tuple(op[1:]))
+        )
+        self.combine(token.rid, h)
+        resp = None
+        q = self._resps[(token.rid, token.tid)]
+        while q:
+            resp = q.popleft()
+        return resp
+
+    def execute(self, op: tuple, token: ReplicaToken):
+        """Read path: sync only the mapped log, then dispatch locally
+        (`cnr/src/replica.rs:599-617`)."""
+        h = self._map(op)
+        rid = token.rid
+        ctail = int(np.asarray(self.ml.ctail)[h])
+        rounds = 0
+        while int(np.asarray(self.ml.ltails)[h, rid]) < ctail:
+            self._exec_round(h)
+            rounds = self._watchdog(rounds, h, "read-sync")
+        args = np.zeros((self.spec.arg_width,), np.int32)
+        args[: len(op) - 1] = op[1:]
+        return int(
+            self._read_jit(
+                self.states, jnp.int32(rid), jnp.int32(op[0]),
+                jnp.asarray(args),
+            )
+        )
+
+    def combine(self, rid: int, log_idx: int) -> None:
+        """Drain replica `rid`'s staged ops for `log_idx` (thread order),
+        append them to that log, and replay it until `rid` has applied its
+        own ops — one log's combiner pass (`cnr/src/replica.rs:673-720`)."""
+        ops: list[tuple[int, int, tuple]] = []
+        for tid in range(self._threads_per_replica[rid]):
+            q = self._pending[(rid, tid)]
+            keep = deque()
+            while q:
+                h, opcode, args = q.popleft()
+                if h == log_idx:
+                    ops.append((tid, opcode, args))
+                else:
+                    keep.append((h, opcode, args))
+            q.extend(keep)
+        n = len(ops)
+        if n == 0:
+            self._exec_round(log_idx)
+            return
+        rounds = 0
+        while (
+            self.spec.capacity - self.spec.gc_slack
+            - int(np.asarray(self.ml.tail - self.ml.head)[log_idx])
+        ) < n:
+            self._exec_round(log_idx)
+            rounds = self._watchdog(rounds, log_idx, "append-gc")
+        pos0 = int(np.asarray(self.ml.tail)[log_idx])
+        pad = 1 << (max(n, 1) - 1).bit_length()
+        opcodes, args, _ = encode_ops(
+            [(o, *a) for _, o, a in ops], self.spec.arg_width, pad_to=pad
+        )
+        self.ml = self._append_jit(
+            self.ml, log_idx, opcodes, args, jnp.int64(n)
+        )
+        infl = self._inflight.setdefault((rid, log_idx), deque())
+        for j, (tid, _, _) in enumerate(ops):
+            infl.append((pos0 + j, tid))
+        target = pos0 + n
+        rounds = 0
+        while int(np.asarray(self.ml.ltails)[log_idx, rid]) < target:
+            self._exec_round(log_idx)
+            rounds = self._watchdog(rounds, log_idx, "combine-replay")
+
+    def sync(self, rid: int | None = None) -> None:
+        """Catch up on every log (`cnr/src/replica.rs:579-597`)."""
+        for l in range(self.nlogs):
+            self.sync_log(rid, l)
+
+    def sync_log(self, rid: int | None, log_idx: int) -> None:
+        """Targeted single-log sync (`sync_log`,
+        `cnr/src/replica.rs:579-597`). The harness wires the GC callback
+        to this, answering starvation reports (`benches/mkbench.rs:
+        763-772`)."""
+        rounds = 0
+        while True:
+            lt = np.asarray(self.ml.ltails)[log_idx]
+            tail = int(np.asarray(self.ml.tail)[log_idx])
+            done = (
+                all(int(x) >= tail for x in lt)
+                if rid is None
+                else int(lt[rid]) >= tail
+            )
+            if done:
+                return
+            self._exec_round(log_idx)
+            rounds = self._watchdog(rounds, log_idx, "sync")
+
+    def verify(self, fn: Callable[[Any], Any], rid: int = 0):
+        self.sync()
+        state = jax.tree.map(lambda a: np.asarray(a[rid]), self.states)
+        return fn(state)
+
+    def replicas_equal(self) -> bool:
+        return all(
+            jax.tree.leaves(
+                jax.tree.map(
+                    lambda a: bool(
+                        np.all(np.asarray(a) == np.asarray(a)[0:1])
+                    ),
+                    self.states,
+                )
+            )
+        )
+
+    def stats(self) -> dict:
+        return {
+            "tails": [int(t) for t in np.asarray(self.ml.tail)],
+            "ctails": [int(t) for t in np.asarray(self.ml.ctail)],
+            "heads": [int(t) for t in np.asarray(self.ml.head)],
+        }
+
+    # ------------------------------------------------------------ internals
+
+    def _exec_round(self, log_idx: int) -> None:
+        lt_before = np.asarray(self.ml.ltails)[log_idx].copy()
+        self.ml, self.states, resps = self._exec_jit(
+            self.ml, self.states, log_idx=log_idx, window=self.exec_window
+        )
+        lt_after = np.asarray(self.ml.ltails)[log_idx]
+        resps_np = np.asarray(resps)
+        for r in range(self.n_replicas):
+            q = self._inflight.get((r, log_idx))
+            if not q:
+                continue
+            while q and q[0][0] < int(lt_after[r]):
+                pos, tid = q.popleft()
+                self._resps[(r, tid)].append(
+                    int(resps_np[r, pos - int(lt_before[r])])
+                )
+
+    def _watchdog(self, rounds: int, log_idx: int, where: str) -> int:
+        rounds += 1
+        if rounds == WARN_ROUNDS:
+            lt = np.asarray(self.ml.ltails)[log_idx]
+            dormant = int(np.argmin(lt))
+            logger.warning(
+                "cnr replay stalled in %s on log %d after %d rounds; "
+                "dormant replica=%d (ltail=%d, tail=%d)",
+                where, log_idx, rounds, dormant, int(lt[dormant]),
+                int(np.asarray(self.ml.tail)[log_idx]),
+            )
+            if self.gc_callback is not None:
+                self.gc_callback(log_idx, dormant)
+        return rounds

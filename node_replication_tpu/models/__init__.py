@@ -31,6 +31,12 @@ from node_replication_tpu.models.memfs import (
     make_memfs,
     memfs_log_mapper,
 )
+from node_replication_tpu.models.oahashmap import (
+    OA_GET,
+    OA_PUT,
+    OA_REMOVE,
+    make_oahashmap,
+)
 from node_replication_tpu.models.sortedset import (
     SS_CONTAINS,
     SS_INSERT,
@@ -65,6 +71,10 @@ __all__ = [
     "FS_WRITE",
     "make_memfs",
     "memfs_log_mapper",
+    "OA_GET",
+    "OA_PUT",
+    "OA_REMOVE",
+    "make_oahashmap",
     "SS_CONTAINS",
     "SS_INSERT",
     "SS_RANGE_COUNT",

@@ -1,0 +1,115 @@
+"""Open-addressing hash map for sparse keyspaces.
+
+The dense `models/hashmap.py` assumes a bounded keyspace (table slot =
+`k % K`). This variant is a real hash table over arbitrary int32 keys —
+the analog of the reference bench's 50M-keyspace map (`benches/hashmap.rs:
+29-48`) when the keyspace can't be materialized densely.
+
+TPU-first design: linear probing with a STATIC probe window of `probe`
+slots. Every op is a fixed-shape gather of the window, a masked
+first-match/first-free selection, and one scatter — no data-dependent
+loops, so it vectorizes across the vmapped replica axis like any other
+model. Tombstones keep lookups correct after removals; keys are only ever
+stored inside their own probe window, so membership = "match anywhere in
+the window" without early-exit scanning.
+
+An insert whose window is full is DROPPED with resp = -2: deterministic
+(every replica replays the same outcome), mirroring how the bounded stack
+drops overflowing pushes. Size the table ≥ 2× the live key count to make
+that a non-event.
+
+Write opcodes: OA_PUT=1 (k, v → 0 ok, -2 window-full),
+OA_REMOVE=2 (k → 1 was present, 0 absent).
+Read opcodes: OA_GET=1 (k → value, or -1 absent).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from node_replication_tpu.ops.encoding import Dispatch
+
+OA_PUT = 1
+OA_REMOVE = 2
+OA_GET = 1
+
+ABSENT = -1
+DROPPED = -2
+
+_EMPTY = 0
+_OCC = 1
+_TOMB = 2
+
+
+def _mix(x):
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def make_oahashmap(n_slots: int, probe: int = 16) -> Dispatch:
+    """Open-addressed table of `n_slots` with a `probe`-slot linear window."""
+
+    def make_state():
+        return {
+            "keys": jnp.zeros((n_slots,), jnp.int32),
+            "vals": jnp.zeros((n_slots,), jnp.int32),
+            "flag": jnp.zeros((n_slots,), jnp.int32),
+        }
+
+    def _window(k):
+        h = (_mix(k) % jnp.uint32(n_slots)).astype(jnp.int32)
+        return (h + jnp.arange(probe, dtype=jnp.int32)) % n_slots
+
+    def put(state, args):
+        k, v = args[0], args[1]
+        idx = _window(k)
+        flags = state["flag"][idx]
+        match = (flags == _OCC) & (state["keys"][idx] == k)
+        free = flags != _OCC
+        any_match = jnp.any(match)
+        any_free = jnp.any(free)
+        target = jnp.where(
+            any_match, jnp.argmax(match), jnp.argmax(free)
+        )
+        ok = any_match | any_free
+        # dropped ops scatter to n_slots → mode="drop" discards
+        slot = jnp.where(ok, idx[target], n_slots).astype(jnp.int32)
+        return {
+            "keys": state["keys"].at[slot].set(k, mode="drop"),
+            "vals": state["vals"].at[slot].set(v, mode="drop"),
+            "flag": state["flag"].at[slot].set(_OCC, mode="drop"),
+        }, jnp.where(ok, jnp.int32(0), jnp.int32(DROPPED))
+
+    def remove(state, args):
+        k = args[0]
+        idx = _window(k)
+        match = (state["flag"][idx] == _OCC) & (state["keys"][idx] == k)
+        was = jnp.any(match)
+        slot = jnp.where(was, idx[jnp.argmax(match)], n_slots).astype(
+            jnp.int32
+        )
+        return {
+            "keys": state["keys"],
+            "vals": state["vals"],
+            "flag": state["flag"].at[slot].set(_TOMB, mode="drop"),
+        }, was.astype(jnp.int32)
+
+    def get(state, args):
+        k = args[0]
+        idx = _window(k)
+        match = (state["flag"][idx] == _OCC) & (state["keys"][idx] == k)
+        return jnp.where(
+            jnp.any(match),
+            state["vals"][idx[jnp.argmax(match)]],
+            jnp.int32(ABSENT),
+        )
+
+    return Dispatch(
+        name=f"oahashmap{n_slots}p{probe}",
+        make_state=make_state,
+        write_ops=(put, remove),
+        read_ops=(get,),
+        arg_width=3,
+    )

@@ -79,8 +79,9 @@ def _noop_read(state: PyTree, args: jax.Array):
 def apply_write(d: Dispatch, state: PyTree, opcode: jax.Array, args: jax.Array):
     """Apply one encoded write op: the jit-safe `dispatch_mut`.
 
-    Unknown / out-of-range opcodes clamp onto the NOOP branch, mirroring how
-    padded log slots must replay as no-ops.
+    Unknown / out-of-range opcodes route to the NOOP branch (inert),
+    mirroring how padded log slots must replay as no-ops — and matching
+    the native engine's unknown-opcode behavior for differential tests.
     """
 
     def wrap(f):
@@ -91,7 +92,8 @@ def apply_write(d: Dispatch, state: PyTree, opcode: jax.Array, args: jax.Array):
         return g
 
     branches = (_noop_write,) + tuple(wrap(f) for f in d.write_ops)
-    idx = jnp.clip(opcode, 0, len(branches) - 1)
+    valid = (opcode >= 0) & (opcode < len(branches))
+    idx = jnp.where(valid, opcode, 0)
     return lax.switch(idx, branches, state, args)
 
 
@@ -105,7 +107,8 @@ def apply_read(d: Dispatch, state: PyTree, opcode: jax.Array, args: jax.Array):
         return g
 
     branches = (_noop_read,) + tuple(wrap(f) for f in d.read_ops)
-    idx = jnp.clip(opcode, 0, len(branches) - 1)
+    valid = (opcode >= 0) & (opcode < len(branches))
+    idx = jnp.where(valid, opcode, 0)
     return lax.switch(idx, branches, state, args)
 
 

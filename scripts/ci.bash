@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Local CI driver: the analog of the reference's `scripts/ci.bash` (runs
+# every suite, collects CSVs, renders plots — `scripts/ci.bash:7-90`).
+# Usage: scripts/ci.bash [outdir]   (FULL=1 for reference-scale workloads)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=${1:-ci-out}
+mkdir -p "$OUT"
+
+echo "== tests =="
+python -m pytest tests/ -q
+
+echo "== examples =="
+for f in examples/*.py; do python "$f"; done
+
+echo "== flagship bench =="
+python bench.py --replicas 256 --keys 1024 --steps 10 --warmup 2 \
+  | tee "$OUT/bench.json"
+
+echo "== bench suite =="
+DUR=${DUR:-1.0} FULL=${FULL:-} bash benches/run_all.sh
+cp -f benches/out/*.csv "$OUT/" 2>/dev/null || true
+
+echo "== plots =="
+python benches/plot.py --csv "$OUT/scaleout_benchmarks.csv" \
+  --out "$OUT" || echo "(no scaleout CSV to plot)"
+
+echo "CI OK — artifacts in $OUT/"

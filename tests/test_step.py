@@ -106,3 +106,27 @@ class TestValidation:
         spec = LogSpec(capacity=64, n_replicas=8, arg_width=3, gc_slack=8)
         with pytest.raises(ValueError):
             make_step(d, spec, writes_per_replica=16, reads_per_replica=1)
+
+
+class TestUnknownOpcodes:
+    def test_out_of_range_opcodes_are_inert(self):
+        # Contract shared with the native engine: unknown opcodes replay
+        # as NOOPs (resp 0, state unchanged) — they must NOT clamp onto a
+        # real branch.
+        import numpy as np
+
+        from node_replication_tpu.core.replica import NodeReplicated
+        from node_replication_tpu.models import HM_PUT, make_hashmap
+
+        nr = NodeReplicated(
+            make_hashmap(16), n_replicas=1, log_entries=512, gc_slack=16
+        )
+        t = nr.register(0)
+        nr.execute_mut((HM_PUT, 3, 33), t)
+        before = nr.verify(lambda s: (s["values"].copy(),
+                                      s["present"].copy()))
+        assert nr.execute_mut((999, 3, 0), t) == 0
+        assert nr.execute((999, 3), t) == 0
+        after = nr.verify(lambda s: (s["values"], s["present"]))
+        np.testing.assert_array_equal(before[0], after[0])
+        np.testing.assert_array_equal(before[1], after[1])
