@@ -52,7 +52,7 @@ def main():
     )
     for ax, panel in zip(axes[:, 0], panels):
         for (name, ls, batch), by_r in sorted(agg.items()):
-            if not name.startswith(panel):
+            if name.split("/")[0] != panel:
                 continue
             rs = sorted(by_r)
             mops = [

@@ -36,6 +36,7 @@ from node_replication_tpu.core.replica import (
     MAX_THREADS_PER_REPLICA,
     ReplicaToken,
     replicate_state,
+    states_equal,
 )
 from node_replication_tpu.ops.encoding import Dispatch, apply_read, encode_ops
 
@@ -260,16 +261,7 @@ class MultiLogReplicated:
         return fn(state)
 
     def replicas_equal(self) -> bool:
-        return all(
-            jax.tree.leaves(
-                jax.tree.map(
-                    lambda a: bool(
-                        np.all(np.asarray(a) == np.asarray(a)[0:1])
-                    ),
-                    self.states,
-                )
-            )
-        )
+        return states_equal(self.states)
 
     def stats(self) -> dict:
         return {

@@ -91,6 +91,20 @@ def replicate_state(state, n_replicas: int):
     )
 
 
+def states_equal(states) -> bool:
+    """All replicas of an [R, ...] state pytree are bit-identical (the
+    `replicas_are_equal` convergence idiom, `nr/tests/stack.rs:434-489`).
+    Shared by every runner/wrapper so the check can't drift."""
+    return all(
+        jax.tree.leaves(
+            jax.tree.map(
+                lambda a: bool(np.all(np.asarray(a) == np.asarray(a)[0:1])),
+                states,
+            )
+        )
+    )
+
+
 class NodeReplicated:
     """N replicas of one `Dispatch` data structure behind a shared log.
 
@@ -346,15 +360,8 @@ class NodeReplicated:
         return fn(state)
 
     def replicas_equal(self) -> bool:
-        """All replicas converged to identical state (the
-        `replicas_are_equal` idiom, `nr/tests/stack.rs:434-489`)."""
-        leaves = jax.tree.leaves(
-            jax.tree.map(
-                lambda a: bool(np.all(np.asarray(a) == np.asarray(a)[0:1])),
-                self.states,
-            )
-        )
-        return all(leaves)
+        """All replicas converged to identical state."""
+        return states_equal(self.states)
 
     # ------------------------------------------------------------ internals
 

@@ -18,6 +18,7 @@ from node_replication_tpu.harness.trait import (
     NativeRunner,
     PartitionedRunner,
     ReplicatedRunner,
+    ShardedRunner,
 )
 from node_replication_tpu.harness.workloads import (
     WorkloadSpec,
@@ -36,6 +37,7 @@ __all__ = [
     "PartitionedRunner",
     "ConcurrentDsRunner",
     "NativeRunner",
+    "ShardedRunner",
     "WorkloadSpec",
     "generate_batches",
     "zipf_keys",

@@ -33,6 +33,7 @@ from node_replication_tpu.harness.trait import (
     NativeRunner,
     PartitionedRunner,
     ReplicatedRunner,
+    ShardedRunner,
 )
 from node_replication_tpu.harness.workloads import (
     WorkloadSpec,
@@ -228,6 +229,13 @@ class ScaleBenchBuilder:
             return PartitionedRunner(d, R, bw, br)
         if system == "concurrent" and nlogs == 1:
             return ConcurrentDsRunner(d, R, bw, br)
+        if system == "sharded" and nlogs == 1:
+            import jax as _jax
+
+            n_dev = len(_jax.devices())
+            if R % n_dev == 0:
+                return ShardedRunner(d, R, bw, br, n_devices=n_dev,
+                                     log_capacity=self._log_capacity)
         return None
 
     def run(self) -> list[MeasureResult]:

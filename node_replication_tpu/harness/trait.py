@@ -105,16 +105,9 @@ class ReplicatedRunner(FleetRunner):
         return jax.tree.map(lambda a: np.asarray(a[rid]), self.states)
 
     def replicas_equal(self) -> bool:
-        return all(
-            jax.tree.leaves(
-                jax.tree.map(
-                    lambda a: bool(
-                        np.all(np.asarray(a) == np.asarray(a)[0:1])
-                    ),
-                    self.states,
-                )
-            )
-        )
+        from node_replication_tpu.core.replica import states_equal
+
+        return states_equal(self.states)
 
 
 class MultiLogRunner(FleetRunner):
@@ -288,6 +281,58 @@ class ConcurrentDsRunner(FleetRunner):
 
     def state_dump(self, rid: int = 0):
         return jax.tree.map(np.asarray, self.state)
+
+
+class ShardedRunner(ReplicatedRunner):
+    """NR fleet sharded over a device mesh: the harness form of the
+    multi-chip path. Replica states shard over the mesh's 'replica' axis
+    (the ReplicaStrategy↔mesh-shape analog, `benches/mkbench.rs:321-362`),
+    the log replicates, and GSPMD places the collectives. Device order
+    comes from the topology walk + ThreadMapping placement
+    (`benches/utils/topology.rs:174-219`). Stepping, fencing, and state
+    inspection are inherited from `ReplicatedRunner` — only construction
+    (mesh + sharded jit) and batch placement differ."""
+
+    def __init__(self, dispatch: Dispatch, n_replicas: int,
+                 writes_per_replica: int, reads_per_replica: int,
+                 n_devices: int | None = None,
+                 thread_mapping=None,
+                 log_capacity: int | None = None):
+        from node_replication_tpu.parallel.mesh import (
+            make_mesh,
+            place,
+            shard_step,
+        )
+        from node_replication_tpu.parallel.topology import (
+            MachineTopology,
+            ThreadMapping,
+        )
+
+        topo = MachineTopology()
+        n_devices = n_devices or topo.n_devices()
+        mapping = thread_mapping or ThreadMapping.SEQUENTIAL
+        devices = topo.allocate(mapping, n_devices)
+        if n_replicas % n_devices:
+            raise ValueError(
+                f"R={n_replicas} not divisible by {n_devices} devices"
+            )
+        super().__init__(dispatch, n_replicas, writes_per_replica,
+                         reads_per_replica, log_capacity)
+        self.name = f"nr-mesh{n_devices}"
+        self.mesh = make_mesh(n_devices, 1, devices=devices)
+        base = make_step(dispatch, self.spec, self.Bw, self.Br, jit=False)
+        self.log, self.states = place(self.log, self.states, self.mesh)
+        self.step = shard_step(
+            base, self.mesh, self.log, self.states, donate=True
+        )
+
+    def prepare(self, wr_opc, wr_args, rd_opc, rd_args):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # batches shard over 'replica' on their R axis (axis 1 of [S, R, B])
+        sh = NamedSharding(self.mesh, P(None, "replica"))
+        self._w = (jax.device_put(wr_opc, sh), jax.device_put(wr_args, sh))
+        self._r = (jax.device_put(rd_opc, sh), jax.device_put(rd_args, sh))
 
 
 class NativeRunner:

@@ -37,6 +37,13 @@ from node_replication_tpu.models.oahashmap import (
     OA_REMOVE,
     make_oahashmap,
 )
+from node_replication_tpu.models.queue import (
+    Q_DEQ,
+    Q_ENQ,
+    Q_FRONT,
+    Q_LEN,
+    make_queue,
+)
 from node_replication_tpu.models.sortedset import (
     SS_CONTAINS,
     SS_INSERT,
@@ -71,6 +78,11 @@ __all__ = [
     "FS_WRITE",
     "make_memfs",
     "memfs_log_mapper",
+    "Q_DEQ",
+    "Q_ENQ",
+    "Q_FRONT",
+    "Q_LEN",
+    "make_queue",
     "OA_GET",
     "OA_PUT",
     "OA_REMOVE",

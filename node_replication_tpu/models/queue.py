@@ -1,0 +1,70 @@
+"""Replicated bounded FIFO queue.
+
+The reference's cnr stack example replicates a concurrent `SegQueue`
+(`cnr/examples/stack.rs` uses crossbeam's queue as the internally-
+concurrent data structure). This is that structure's TPU model: a bounded
+ring of int32 values with monotone head/tail cursors — enqueue is one
+scatter, dequeue one gather, both fixed-shape.
+
+Write opcodes: Q_ENQ=1 (v → new length, or -1 when full),
+Q_DEQ=2 (→ dequeued value, or -1 when empty).
+Read opcodes: Q_FRONT=1 (→ front value or -1), Q_LEN=2 (→ length).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from node_replication_tpu.ops.encoding import Dispatch
+
+Q_ENQ = 1
+Q_DEQ = 2
+Q_FRONT = 1
+Q_LEN = 2
+
+EMPTY = -1
+
+
+def make_queue(capacity: int) -> Dispatch:
+    """Bounded FIFO over a power-of-two-free ring (modulo indexing)."""
+
+    def make_state():
+        return {
+            "buf": jnp.zeros((capacity,), jnp.int32),
+            "head": jnp.zeros((), jnp.int32),
+            "tail": jnp.zeros((), jnp.int32),
+        }
+
+    def enq(state, args):
+        n = state["tail"] - state["head"]
+        ok = n < capacity
+        idx = jnp.where(ok, state["tail"] % capacity, 0)
+        buf = jnp.where(ok, state["buf"].at[idx].set(args[0]), state["buf"])
+        tail = jnp.where(ok, state["tail"] + 1, state["tail"])
+        return {"buf": buf, "head": state["head"], "tail": tail}, jnp.where(
+            ok, n + 1, jnp.int32(EMPTY)
+        )
+
+    def deq(state, args):
+        ok = state["tail"] > state["head"]
+        idx = jnp.where(ok, state["head"] % capacity, 0)
+        val = jnp.where(ok, state["buf"][idx], jnp.int32(EMPTY))
+        head = jnp.where(ok, state["head"] + 1, state["head"])
+        return {"buf": state["buf"], "head": head, "tail": state["tail"]}, val
+
+    def front(state, args):
+        ok = state["tail"] > state["head"]
+        return jnp.where(
+            ok, state["buf"][state["head"] % capacity], jnp.int32(EMPTY)
+        )
+
+    def length(state, args):
+        return state["tail"] - state["head"]
+
+    return Dispatch(
+        name=f"queue{capacity}",
+        make_state=make_state,
+        write_ops=(enq, deq),
+        read_ops=(front, length),
+        arg_width=3,
+    )

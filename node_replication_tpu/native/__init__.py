@@ -126,6 +126,7 @@ def load() -> ctypes.CDLL:
 
 from node_replication_tpu.native.engine import (  # noqa: E402
     MODEL_HASHMAP,
+    MODEL_SORTEDSET,
     MODEL_STACK,
     NativeEngine,
     NativeRwLock,
@@ -138,4 +139,5 @@ __all__ = [
     "NativeRwLock",
     "MODEL_HASHMAP",
     "MODEL_STACK",
+    "MODEL_SORTEDSET",
 ]

@@ -15,6 +15,7 @@ import numpy as np
 
 MODEL_HASHMAP = 1
 MODEL_STACK = 2
+MODEL_SORTEDSET = 3
 
 
 class NativeEngine:

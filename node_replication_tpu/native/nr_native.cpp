@@ -248,12 +248,77 @@ static void st_dump(void *p, int32_t *out) {
   for (int64_t i = 0; i < s->capacity; i++) out[1 + i] = s->buf[i];
 }
 
+// --- model 3: sorted set over a bounded keyspace (mirrors
+// models/sortedset.py: SS_INSERT=1 k → newly-inserted; SS_REMOVE=2 k →
+// was-present; reads SS_CONTAINS=1 k, SS_RANGE_COUNT=2 (lo, hi),
+// SS_RANK=3 k). Per-key atomic flags: inserts/removes on distinct keys
+// commute, so the model is CNR-safe; ordered reads are relaxed scans
+// (aggregate reads over a concurrently-mutating set are not atomic
+// snapshots in the reference's skiplist either).
+struct SortedSetState {
+  int64_t n_keys;
+  std::atomic<uint8_t> *present;
+};
+
+static void *ss_create(int64_t n_keys) {
+  auto *s = new SortedSetState();
+  s->n_keys = n_keys;
+  s->present = new std::atomic<uint8_t>[n_keys]();
+  return s;
+}
+static void ss_destroy(void *p) {
+  auto *s = static_cast<SortedSetState *>(p);
+  delete[] s->present;
+  delete s;
+}
+static int32_t ss_mut(void *p, int32_t opcode, const int32_t *args) {
+  auto *s = static_cast<SortedSetState *>(p);
+  int64_t k = ((int64_t)args[0] % s->n_keys + s->n_keys) % s->n_keys;
+  if (opcode == 1)
+    return s->present[k].exchange(1, std::memory_order_acq_rel) ? 0 : 1;
+  if (opcode == 2)
+    return s->present[k].exchange(0, std::memory_order_acq_rel) ? 1 : 0;
+  return 0;
+}
+static int32_t ss_rd(void *p, int32_t opcode, const int32_t *args) {
+  auto *s = static_cast<SortedSetState *>(p);
+  if (opcode == 1) {
+    int64_t k = ((int64_t)args[0] % s->n_keys + s->n_keys) % s->n_keys;
+    return s->present[k].load(std::memory_order_acquire);
+  }
+  if (opcode == 2) {  // range_count [lo, hi)
+    int64_t lo = args[0] < 0 ? 0 : args[0];
+    int64_t hi = args[1] > s->n_keys ? s->n_keys : args[1];
+    int32_t n = 0;
+    for (int64_t i = lo; i < hi; i++)
+      n += s->present[i].load(std::memory_order_relaxed);
+    return n;
+  }
+  if (opcode == 3) {  // rank: #elements < k
+    int64_t hi = args[0] > s->n_keys ? s->n_keys : args[0];
+    int32_t n = 0;
+    for (int64_t i = 0; i < hi; i++)
+      n += s->present[i].load(std::memory_order_relaxed);
+    return n;
+  }
+  return 0;
+}
+static int64_t ss_words(void *p) {
+  return static_cast<SortedSetState *>(p)->n_keys;
+}
+static void ss_dump(void *p, int32_t *out) {
+  auto *s = static_cast<SortedSetState *>(p);
+  for (int64_t i = 0; i < s->n_keys; i++)
+    out[i] = s->present[i].load(std::memory_order_acquire);
+}
+
 static const Model kModels[] = {
     {nullptr, nullptr, nullptr, nullptr, nullptr, nullptr, 0},  // 0 unused
     {hm_create, hm_destroy, hm_mut, hm_rd, hm_words, hm_dump, 1},
     {st_create, st_destroy, st_mut, st_rd, st_words, st_dump, 0},
+    {ss_create, ss_destroy, ss_mut, ss_rd, ss_words, ss_dump, 1},
 };
-static const int kNumModels = 3;
+static const int kNumModels = 4;
 
 // ------------------------------------------------------------------- log
 

@@ -164,3 +164,47 @@ class TestSweepAndCsv:
             .run()
         )
         assert len(res) == 1 and res[0].total_dispatches > 0
+
+
+class TestShardedRunner:
+    def test_matches_single_device_runner(self):
+        # 8-device virtual mesh (conftest): same workload through the
+        # sharded fleet and the single-program fleet must agree exactly.
+        from node_replication_tpu.harness import ShardedRunner
+
+        spec = WorkloadSpec(keyspace=64, seed=21)
+        gen = generate_batches(spec, 4, 16, 2, 2)
+        a = ReplicatedRunner(make_hashmap(64), 16, 2, 2, log_capacity=1 << 10)
+        b = ShardedRunner(make_hashmap(64), 16, 2, 2, n_devices=8,
+                          log_capacity=1 << 10)
+        a.prepare(*gen)
+        b.prepare(*gen)
+        for s in range(4):
+            a.run_step(s)
+            b.run_step(s)
+        a.block()
+        b.block()
+        assert b.replicas_equal()
+        sa, sb = a.state_dump(3), b.state_dump(3)
+        np.testing.assert_array_equal(sa["values"], sb["values"])
+        np.testing.assert_array_equal(sa["present"], sb["present"])
+
+    def test_sweep_includes_sharded_system(self, tmp_path):
+        res = (
+            ScaleBenchBuilder(
+                lambda: make_hashmap(64), "sh", WorkloadSpec(keyspace=64)
+            )
+            .replicas([8])
+            .batches([4])
+            .systems(["sharded"])
+            .duration(0.1)
+            .out_dir(str(tmp_path))
+            .run()
+        )
+        assert len(res) == 1 and res[0].total_dispatches > 0
+
+    def test_indivisible_replica_count_raises(self):
+        from node_replication_tpu.harness import ShardedRunner
+
+        with pytest.raises(ValueError, match="not divisible"):
+            ShardedRunner(make_hashmap(64), 6, 1, 1, n_devices=4)

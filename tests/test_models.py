@@ -144,3 +144,45 @@ class TestSortedSet:
 
     def test_log_mapper_by_key(self):
         assert sortedset_log_mapper(SS_INSERT, (17,)) == 17
+
+
+class TestQueue:
+    def test_fifo_semantics_vs_shadow(self):
+        import random
+        from collections import deque as _dq
+
+        from node_replication_tpu.core.replica import NodeReplicated
+        from node_replication_tpu.models import (
+            Q_DEQ,
+            Q_ENQ,
+            Q_FRONT,
+            Q_LEN,
+            make_queue,
+        )
+
+        nr = NodeReplicated(
+            make_queue(16), n_replicas=2, log_entries=512, gc_slack=16
+        )
+        t = nr.register(0)
+        shadow: _dq = _dq()
+        rng = random.Random(2)
+        for i in range(300):
+            p = rng.random()
+            if p < 0.5:
+                resp = nr.execute_mut((Q_ENQ, i), t)
+                if len(shadow) < 16:
+                    shadow.append(i)
+                    assert resp == len(shadow)
+                else:
+                    assert resp == -1  # full
+            elif p < 0.8:
+                resp = nr.execute_mut((Q_DEQ,), t)
+                assert resp == (shadow.popleft() if shadow else -1)
+            elif p < 0.9:
+                assert nr.execute((Q_FRONT,), t) == (
+                    shadow[0] if shadow else -1
+                )
+            else:
+                assert nr.execute((Q_LEN,), t) == len(shadow)
+        nr.sync()
+        assert nr.replicas_equal()

@@ -400,3 +400,72 @@ class TestBenchEntryPoints:
 
     def test_log_append_bench_smoke(self):
         assert bench_log_append(1 << 12, 2, 16, 50) > 0
+
+
+class TestNativeSortedSet:
+    def test_differential_vs_jax_sortedset(self):
+        import random
+
+        from node_replication_tpu.core.replica import NodeReplicated
+        from node_replication_tpu.models import make_sortedset
+        from node_replication_tpu.native import MODEL_SORTEDSET
+
+        K, R, N = 64, 2, 300
+        rng = random.Random(12)
+        jx = NodeReplicated(
+            make_sortedset(K), n_replicas=R, log_entries=1 << 10,
+            gc_slack=64,
+        )
+        nat = NativeEngine(MODEL_SORTEDSET, K, n_replicas=R,
+                           log_capacity=1 << 10)
+        jt = [jx.register(r) for r in range(R)]
+        nt = [nat.register(r) for r in range(R)]
+        for _ in range(N):
+            r = rng.randrange(R)
+            k = rng.randrange(K)
+            p = rng.random()
+            if p < 0.4:
+                op = (1, k)
+                assert jx.execute_mut(op, jt[r]) == nat.execute_mut(op, nt[r])
+            elif p < 0.6:
+                op = (2, k)
+                assert jx.execute_mut(op, jt[r]) == nat.execute_mut(op, nt[r])
+            elif p < 0.75:
+                op = (1, k)
+                assert jx.execute(op, jt[r]) == nat.execute(op, nt[r])
+            elif p < 0.9:
+                lo = rng.randrange(K)
+                op = (2, lo, lo + rng.randrange(K))
+                assert jx.execute(op, jt[r]) == nat.execute(op, nt[r])
+            else:
+                op = (3, k)
+                assert jx.execute(op, jt[r]) == nat.execute(op, nt[r])
+        jx.sync()
+        nat.sync()
+        st = jx.verify(lambda s: s)
+        np.testing.assert_array_equal(
+            st["present"].astype(np.int32), nat.state_dump(0)
+        )
+        nat.close()
+
+    def test_cnr_mode_concurrent_inserts(self):
+        from node_replication_tpu.native import MODEL_SORTEDSET
+
+        with NativeEngine(MODEL_SORTEDSET, 256, n_replicas=2,
+                          log_capacity=1 << 12, nlogs=4) as e:
+
+            def worker(rid, lo):
+                tok = e.register(rid)
+                for k in range(lo, lo + 100):
+                    e.execute_mut((1, k % 256), tok)
+
+            ts = [
+                threading.Thread(target=worker, args=(g % 2, g * 50))
+                for g in range(4)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            e.sync()
+            assert e.replicas_equal()
