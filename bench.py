@@ -36,8 +36,8 @@ def main():
     p.add_argument("--keys", type=int, default=10_000)
     p.add_argument("--writes-per-replica", type=int, default=1)
     p.add_argument("--reads-per-replica", type=int, default=1)
-    p.add_argument("--steps", type=int, default=30)
-    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--warmup", type=int, default=6)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--pallas", action="store_true",
                    help="hand-tiled Pallas replay kernel instead of the "

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Ring-replay bench: sequence-sharded long-window replay over a mesh.
+
+Measures `parallel/collectives.make_ring_exec` — the long-context story:
+a replay window W sharded over P devices, chunks rotating on the ICI ring
+while replica shards stay resident (2P-1 pipelined rounds, order
+preserved). Compares against single-program replay of the same window.
+
+On single-chip hardware run it on the virtual CPU mesh:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benches/ringreplay.py --cpu --devices 8
+"""
+
+import time
+
+from common import base_parser, finish_args
+
+
+def main():
+    p = base_parser("pipelined ring replay of a sharded window")
+    p.add_argument("--devices", type=int, default=None)
+    p.add_argument("--window", type=int, default=1 << 12)
+    p.add_argument("--keys", type=int, default=1 << 14)
+    args = finish_args(p.parse_args())
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from node_replication_tpu.core.replica import replicate_state
+    from node_replication_tpu.models import make_hashmap
+    from node_replication_tpu.ops.encoding import apply_write
+    from node_replication_tpu.parallel import make_mesh
+    from node_replication_tpu.parallel.collectives import make_ring_exec
+
+    P_ = args.devices or len(jax.devices())
+    W = args.window - args.window % P_
+    R = max(args.replicas)
+    R -= R % P_ or P_
+    R = max(R, P_)
+    d = make_hashmap(args.keys)
+    mesh = make_mesh(P_, 1, devices=jax.devices()[:P_])
+    ring = jax.jit(make_ring_exec(d, mesh))
+
+    rng = np.random.default_rng(args.seed)
+    opc = jnp.ones((W,), jnp.int32)
+    args_arr = jnp.zeros((W, 3), jnp.int32).at[:, 0].set(
+        jnp.asarray(rng.integers(0, args.keys, W), jnp.int32)
+    ).at[:, 1].set(jnp.asarray(rng.integers(0, 1000, W), jnp.int32))
+    states = replicate_state(d.init_state(), R)
+
+    def seq(opc, a, states):
+        def body(st, x):
+            o, aa = x
+            st, _ = apply_write(d, st, o, aa)
+            return st, 0
+
+        return jax.vmap(
+            lambda s: jax.lax.scan(body, s, (opc, a))[0]
+        )(states)
+
+    seq_jit = jax.jit(seq)
+
+    for name, fn in (("ring", lambda: ring(opc, args_arr, states)),
+                     ("single", lambda: seq_jit(opc, args_arr, states))):
+        out = fn()
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        reps = 3
+        for _ in range(reps):
+            out = fn()
+            jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / reps
+        print(f">> ringreplay/{name} P={P_} W={W} R={R}: "
+              f"{R * W / dt / 1e6:.2f} M replays/s ({dt * 1e3:.1f} ms)")
+
+
+if __name__ == "__main__":
+    main()

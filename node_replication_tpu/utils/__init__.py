@@ -1,0 +1,5 @@
+"""Utilities: tracing/observability helpers."""
+
+from node_replication_tpu.utils.trace import Tracer, get_tracer, span
+
+__all__ = ["Tracer", "get_tracer", "span"]
