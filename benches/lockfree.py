@@ -7,17 +7,23 @@ no-log variant as the comparison (`benches/lockfree_partitioned.rs`).
 from common import base_parser, finish_args
 
 from node_replication_tpu.harness import ScaleBenchBuilder, WorkloadSpec
-from node_replication_tpu.models import make_sortedset
+from node_replication_tpu.models import (
+    make_partitioned_sortedset,
+    make_sortedset,
+)
 
 
 def main():
     p = base_parser("CNR sorted-set log sweep")
     p.add_argument("--keys", type=int, default=None)
     p.add_argument("--logs", type=int, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--no-partition", action="store_true",
+                   help="disable the parallel partitioned replay (fold "
+                        "logs sequentially, the r1 behavior)")
     args = finish_args(p.parse_args())
     keys = args.keys or (1 << 20 if args.full else 1 << 14)
 
-    (
+    builder = (
         ScaleBenchBuilder(
             lambda: make_sortedset(keys),
             f"sortedset{keys}",
@@ -29,8 +35,10 @@ def main():
         .systems(["nr", "cnr", "partitioned"])
         .duration(args.duration)
         .out_dir(args.out_dir)
-        .run()
     )
+    if not args.no_partition:
+        builder.partitioned(lambda L: make_partitioned_sortedset(keys, L))
+    builder.run()
 
 
 if __name__ == "__main__":

@@ -33,8 +33,8 @@ def main():
                 spec, 16, R, batch, 1, wr_opcode=(1, 3), rd_opcode=2
             )
             # keep the block lane in range so writes land
-            wr_args = wr_args.at[..., 1].set(wr_args[..., 1] % args.blocks)
-            wr_args = wr_args.at[..., 2].set(wr_args[..., 1] + 1)
+            wr_args[..., 1] %= args.blocks
+            wr_args[..., 2] = wr_args[..., 1] + 1
             gen = (wr_opc, wr_args, rd_opc, rd_args)
             runner = ReplicatedRunner(
                 make_memfs(files, args.blocks), R, batch, 1

@@ -13,7 +13,7 @@ from node_replication_tpu.harness import WorkloadSpec
 from node_replication_tpu.harness.mkbench import measure_step_runner
 from node_replication_tpu.harness.trait import MultiLogRunner
 from node_replication_tpu.harness.workloads import generate_batches
-from node_replication_tpu.models import make_memfs
+from node_replication_tpu.models import make_memfs, make_partitioned_memfs
 
 
 def main():
@@ -21,6 +21,9 @@ def main():
     p.add_argument("--files", type=int, default=None)
     p.add_argument("--blocks", type=int, default=64)
     p.add_argument("--logs", type=int, nargs="+", default=[1, 4, 8])
+    p.add_argument("--no-partition", action="store_true",
+                   help="sequential per-log fold instead of the parallel "
+                        "partitioned replay")
     args = finish_args(p.parse_args())
     files = args.files or (4096 if args.full else 256)
 
@@ -32,12 +35,16 @@ def main():
                 wr_opc, wr_args, rd_opc, rd_args = generate_batches(
                     spec, 16, R, batch, 1, wr_opcode=(1, 3), rd_opcode=2
                 )
-                wr_args = wr_args.at[..., 1].set(
-                    wr_args[..., 1] % args.blocks
+                wr_args[..., 1] %= args.blocks
+                wr_args[..., 2] = wr_args[..., 1] + 1
+                part = (
+                    make_partitioned_memfs(files, args.blocks, L)
+                    if L > 1 and not args.no_partition and files % L == 0
+                    else None
                 )
-                wr_args = wr_args.at[..., 2].set(wr_args[..., 1] + 1)
                 runner = MultiLogRunner(
-                    make_memfs(files, args.blocks), R, L, batch, 1
+                    make_memfs(files, args.blocks), R, L, batch, 1,
+                    partitioned=part, keyspace=files,
                 )
                 res = measure_step_runner(
                     runner, wr_opc, wr_args, rd_opc, rd_args,

@@ -34,9 +34,7 @@ def main():
             )
             # arg lanes: (vpage, pframe, npages) — give every op a real
             # span so maps/unmaps touch 1..span pages
-            wr_args = wr_args.at[..., 2].set(
-                1 + (wr_args[..., 1] % args.span)
-            )
+            wr_args[..., 2] = 1 + (wr_args[..., 1] % args.span)
             runner = ReplicatedRunner(
                 make_vspace(pages, max_span=args.span), R, batch, 1
             )

@@ -26,9 +26,10 @@ TPU-first re-design (SURVEY.md §7 "CNR"):
 Replay layout: `multilog_exec_all` vmaps the single-log scan over
 (log × replica). Because ops on different logs commute by contract, applying
 each log's span to disjoint *state partitions* is exact. The bundled
-partitioned models (`models/partitioned.py`) expose
-`state_partition(state, log_idx, nlogs)` views; for monolithic states the
-scan falls back to sequential per-log folding (`fold_logs=True`).
+partitioned models (`models/partitioned.py`, `PartitionedModel`) provide
+`split`/`merge` reshapes plus a per-partition sub-Dispatch, so all L scans
+run as ONE vmapped computation — the parallel-combining payoff. For
+monolithic states the replay falls back to sequential per-log folding.
 """
 
 from __future__ import annotations
@@ -167,41 +168,41 @@ def multilog_exec_all(
     ml: MultiLogState,
     states: PyTree,
     window: int,
-    state_partition: Callable | None = None,
+    partitioned: "PartitionedModel | None" = None,
 ):
     """Replay `window` pending entries of every log into every replica.
 
-    With `state_partition(state, log_idx, nlogs) -> (sub, merge_fn)` the L
-    per-log scans run fully vmapped over disjoint state partitions (the
-    parallel-combining payoff, `cnr/src/replica.rs:713-720`). Without it,
-    logs fold sequentially per replica (still correct for any state; ops on
+    With a `PartitionedModel` (`models/partitioned.py`) the L per-log scans
+    run as ONE computation vmapped over (log × replica), each scan mutating
+    only its disjoint state partition — the lock-step analog of L combiners
+    replaying in parallel (`cnr/src/replica.rs:713-720`). Without it, logs
+    fold sequentially per replica (still correct for any state; ops on
     different logs commute by the LogMapper contract so order is free).
 
     Returns `(ml, states, resps[L, R, window])`.
     """
-    if state_partition is not None:
-        subs = []
-        merges = []
-        for l in range(spec.nlogs):
-            sub, merge = state_partition(states, l, spec.nlogs)
-            subs.append(sub)
-            merges.append(merge)
-        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *subs)
+    if partitioned is not None:
+        if partitioned.nlogs != spec.nlogs:
+            raise ValueError(
+                f"PartitionedModel is {partitioned.nlogs}-way but the "
+                f"multilog has {spec.nlogs} logs"
+            )
+        # [R, ...] states → per-replica split → [R, L, sub...] → [L, R, ...]
+        stacked = jax.vmap(partitioned.split)(states)
+        stacked = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), stacked)
 
         def per_log(opc, arg, tail, sub_states, ltails):
             return jax.vmap(
                 lambda s, lt: _exec_one_log(
-                    spec, d, opc, arg, tail, s, lt, window
+                    spec, partitioned.sub, opc, arg, tail, s, lt, window
                 )
             )(sub_states, ltails)
 
         new_subs, resps, new_ltails = jax.vmap(per_log)(
             ml.opcodes, ml.args, ml.tail, stacked, ml.ltails
         )
-        for l in range(spec.nlogs):
-            states = merges[l](
-                states, jax.tree.map(lambda x, _l=l: x[_l], new_subs)
-            )
+        new_subs = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), new_subs)
+        states = jax.vmap(partitioned.merge)(new_subs)
     else:
         resps_list = []
         ltails_list = []
@@ -237,7 +238,7 @@ def make_multilog_step(
     spec: MultiLogSpec,
     writes_per_log: int,
     reads_per_replica: int,
-    state_partition: Callable | None = None,
+    partitioned: "PartitionedModel | None" = None,
     jit: bool = True,
     donate: bool = True,
 ):
@@ -265,7 +266,7 @@ def make_multilog_step(
     def step(ml, states, wr_opcodes, wr_args, counts, rd_opcodes, rd_args):
         ml = multilog_append(spec, ml, wr_opcodes, wr_args, counts)
         ml, states, wr_resps = multilog_exec_all(
-            spec, dispatch, ml, states, B, state_partition=state_partition
+            spec, dispatch, ml, states, B, partitioned=partitioned
         )
         rd_resps = dispatch_reads(dispatch, states, rd_opcodes, rd_args)
         return ml, states, wr_resps, rd_resps

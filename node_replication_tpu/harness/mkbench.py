@@ -188,6 +188,7 @@ class ScaleBenchBuilder:
         self._steps = 16
         self._log_capacity: int | None = None
         self._out_dir = "."
+        self._partitioned_factory: Callable | None = None
 
     def replicas(self, counts: Sequence[int]):
         self._replicas = list(counts)
@@ -214,6 +215,12 @@ class ScaleBenchBuilder:
         self._log_capacity = entries
         return self
 
+    def partitioned(self, factory: Callable):
+        """`factory(nlogs) -> PartitionedModel` enabling parallel per-log
+        replay for the cnr system (`models/partitioned.py`)."""
+        self._partitioned_factory = factory
+        return self
+
     def out_dir(self, path: str):
         self._out_dir = path
         return self
@@ -224,7 +231,19 @@ class ScaleBenchBuilder:
         if system == "nr" and nlogs == 1:
             return ReplicatedRunner(d, R, bw, br, self._log_capacity)
         if system == "cnr" and nlogs > 1:
-            return MultiLogRunner(d, R, nlogs, bw, br, self._log_capacity)
+            part = None
+            if self._partitioned_factory is not None:
+                try:
+                    part = self._partitioned_factory(nlogs)
+                except ValueError as e:
+                    # e.g. keyspace not divisible by this swept nlogs:
+                    # fall back to the sequential fold rather than
+                    # aborting the whole sweep mid-run.
+                    print(f"## cnr{nlogs}: partitioned replay unavailable "
+                          f"({e}); using sequential fold")
+            return MultiLogRunner(d, R, nlogs, bw, br, self._log_capacity,
+                                  partitioned=part,
+                                  keyspace=self.workload.keyspace)
         if system == "partitioned" and nlogs == 1:
             return PartitionedRunner(d, R, bw, br)
         if system == "concurrent" and nlogs == 1:

@@ -115,16 +115,21 @@ class MultiLogRunner(FleetRunner):
 
     Workload writes are re-keyed onto congruence classes (`key ≡ log (mod
     L)`) at prepare time — the LogMapper partition made structural so the
-    per-log batches keep static shapes.
+    per-log batches keep static shapes. Pass a `PartitionedModel`
+    (`models/partitioned.py`) to replay all L logs in one vmapped
+    computation (the parallel-combining payoff); without it the replay
+    folds logs sequentially.
     """
 
     def __init__(self, dispatch: Dispatch, n_replicas: int, nlogs: int,
                  writes_per_log: int, reads_per_replica: int,
-                 log_capacity: int | None = None):
-        self.name = f"cnr{nlogs}"
+                 log_capacity: int | None = None,
+                 partitioned=None, keyspace: int | None = None):
+        self.name = f"cnr{nlogs}" + ("p" if partitioned is not None else "")
         self.dispatch = dispatch
         self.n_replicas = n_replicas
         self.nlogs = nlogs
+        self.keyspace = keyspace
         self.B, self.Br = writes_per_log, reads_per_replica
         self.spec = MultiLogSpec(
             nlogs=nlogs,
@@ -134,7 +139,7 @@ class MultiLogRunner(FleetRunner):
             gc_slack=min(1024, writes_per_log),
         )
         self.step = make_multilog_step(
-            dispatch, self.spec, self.B, self.Br
+            dispatch, self.spec, self.B, self.Br, partitioned=partitioned
         )
         self.ml = multilog_init(self.spec)
         self.states = replicate_state(dispatch.init_state(), n_replicas)
@@ -165,9 +170,23 @@ class MultiLogRunner(FleetRunner):
         flat_args = flat_args[:, :need].reshape(
             S, self.nlogs, self.B, -1
         ).copy()
+        # Re-key within the keyspace truncated to a multiple of L so the
+        # transform both preserves congruence classes AND never produces a
+        # key >= keyspace (which would alias dense cells `k % n_keys`).
+        base = (
+            self.keyspace
+            if self.keyspace is not None
+            else int(flat_args[..., 0].max()) + 1
+        )
+        if base < self.nlogs:
+            raise ValueError(
+                f"keyspace {base} < nlogs {self.nlogs}: the congruence "
+                f"re-key cannot give every log a distinct key class"
+            )
+        k_eff = (base // self.nlogs) * self.nlogs
         lanes = np.arange(self.nlogs, dtype=np.int32)[None, :, None]
         flat_args[..., 0] = (
-            flat_args[..., 0] // self.nlogs
+            (flat_args[..., 0] % k_eff) // self.nlogs
         ) * self.nlogs + lanes
         self._w = (jnp.asarray(flat_opc), jnp.asarray(flat_args))
         self._counts = jnp.full((self.nlogs,), self.B, jnp.int64)

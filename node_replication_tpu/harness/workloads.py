@@ -4,10 +4,16 @@ Port of the reference's generator (`benches/hashmap.rs:131-162`): `nop`
 operations over a bounded keyspace, keys drawn uniform or zipf
 (`benches/hashmap.rs:29-48` uses zipf-or-uniform behind a feature flag),
 write ratio in percent selecting Put vs Get. Everything is generated
-up-front as device arrays shaped `[S, R, B]` (steps × replicas × batch) so
-the measured loop never touches the host (SURVEY.md §7 "honest throughput
-accounting" — and the TPU tunnel makes per-op host→device transfers
-~100ms).
+up-front shaped `[S, R, B]` (steps × replicas × batch) so the measured
+loop never touches the host (SURVEY.md §7 "honest throughput accounting").
+
+Batches are returned as HOST (numpy) arrays and staged onto the device by
+each runner's `prepare`. This is deliberate: on the tunneled TPU platform a
+single device→host transfer degrades every subsequent dispatch ~10×
+(discovered in round 2 — it made CNR look 14× slower than NR in round 1's
+sweeps purely because its `prepare` round-tripped device arrays through
+numpy for re-keying). Keeping generation on host means the measured loop
+performs zero D2H transfers.
 """
 
 from __future__ import annotations
@@ -15,8 +21,6 @@ from __future__ import annotations
 import dataclasses
 
 import numpy as np
-
-import jax.numpy as jnp
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,8 +67,10 @@ def generate_batches(
     the *ratio*, which here fixes the Bw:Br shape instead, keeping shapes
     static for jit.
 
-    Returns `(wr_opc, wr_args, rd_opc, rd_args)` as jnp arrays:
-    `wr_opc int32[S, R, Bw]`, `wr_args int32[S, R, Bw, A]`, etc.
+    Returns `(wr_opc, wr_args, rd_opc, rd_args)` as HOST numpy arrays
+    (`wr_opc int32[S, R, Bw]`, `wr_args int32[S, R, Bw, A]`, etc.) —
+    runners `device_put` them in `prepare` (see module docstring for why
+    they must not start life on device).
     """
     rng = np.random.default_rng(spec.seed)
     S, R, Bw, Br = n_steps, n_replicas, writes_per_replica, reads_per_replica
@@ -88,12 +94,7 @@ def generate_batches(
     rd_opc = opcodes(rd_opcode, (S, R, Br))
     rd_args = np.zeros((S, R, Br, arg_width), np.int32)
     rd_args[..., 0] = keys(S * R * Br).reshape(S, R, Br)
-    return (
-        jnp.asarray(wr_opc),
-        jnp.asarray(wr_args),
-        jnp.asarray(rd_opc),
-        jnp.asarray(rd_args),
-    )
+    return wr_opc, wr_args, rd_opc, rd_args
 
 
 def split_write_read(total_per_replica: int, write_ratio: int) -> tuple[int, int]:

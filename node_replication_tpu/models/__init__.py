@@ -44,6 +44,12 @@ from node_replication_tpu.models.queue import (
     Q_LEN,
     make_queue,
 )
+from node_replication_tpu.models.partitioned import (
+    PartitionedModel,
+    make_partitioned_hashmap,
+    make_partitioned_memfs,
+    make_partitioned_sortedset,
+)
 from node_replication_tpu.models.sortedset import (
     SS_CONTAINS,
     SS_INSERT,
@@ -87,6 +93,10 @@ __all__ = [
     "OA_PUT",
     "OA_REMOVE",
     "make_oahashmap",
+    "PartitionedModel",
+    "make_partitioned_hashmap",
+    "make_partitioned_memfs",
+    "make_partitioned_sortedset",
     "SS_CONTAINS",
     "SS_INSERT",
     "SS_RANGE_COUNT",

@@ -1,0 +1,167 @@
+"""State-partitioned models: the parallel multi-log replay payoff.
+
+The reference's CNR exists so that L combiners replay L logs *in parallel*
+(`cnr/src/replica.rs:93-98`, dispatch concurrent across logs at
+`cnr/src/replica.rs:713-720`); its lockfree bench sweeps #logs to show
+throughput rising with L (`benches/lockfree.rs:243-276`). The TPU
+equivalent: because the LogMapper contract guarantees ops on different logs
+commute (`cnr/src/lib.rs:123-137`), each log's span can be applied to a
+*disjoint partition* of the state — and then all L per-log scans run as one
+`vmap` over the (log × replica) axes instead of a sequential per-log fold.
+
+A `PartitionedModel` packages what that needs:
+
+- `full`   — the ordinary `Dispatch` (reads always run against merged full
+  state; also the fold-path replay dispatch for differential tests),
+- `sub`    — a `Dispatch` over ONE partition's sub-state; write args arrive
+  untransformed (full keys), the sub ops map them into the partition
+  (`k → k // L` for the congruence partition),
+- `split(state) -> stacked` — reshape the state pytree into `[L, ...]`
+  stacked partitions (pure layout change, no gather),
+- `merge(stacked) -> state` — the inverse.
+
+The bundled partitions are *congruence classes of args[0]* (key for
+hashmap / sorted set, fd for memfs): partition l owns every key ≡ l
+(mod L), matching the benches' LogMapper `hash = args[0] % nlogs`. Keys
+land in slot `k // L` of their partition, so `split` is a reshape
+`[K] → [K/L, L] → (moveaxis) → [L, K/L]`.
+
+Correctness contract: replay through `split → vmapped per-log scans with
+`sub` → merge` is bit-identical to the sequential fold with `full` IFF
+every op appended to log l satisfies `args[0] % L == l` (the LogMapper
+invariant). Ops that violate it would mutate the wrong partition — the
+same undefined behavior the reference ascribes to a non-conforming
+LogMapper impl.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from node_replication_tpu.models.hashmap import make_hashmap
+from node_replication_tpu.models.memfs import make_memfs
+from node_replication_tpu.models.sortedset import make_sortedset
+from node_replication_tpu.ops.encoding import Dispatch
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionedModel:
+    """A Dispatch plus its L-way disjoint state partition (frozen →
+    hashable → usable as a jit static argument)."""
+
+    full: Dispatch
+    sub: Dispatch
+    nlogs: int
+    split: Callable[[PyTree], PyTree]
+    merge: Callable[[PyTree], PyTree]
+
+    @property
+    def name(self) -> str:
+        return f"{self.full.name}/p{self.nlogs}"
+
+
+def _congruence_split(nlogs: int):
+    """split/merge for pytrees whose every leaf is keyed by a leading axis
+    of congruence classes: `[K, ...] → [L, K/L, ...]` with
+    `stacked[l, j] = state[j * L + l]`."""
+
+    def split(state: PyTree) -> PyTree:
+        return jax.tree.map(
+            lambda x: jnp.moveaxis(
+                x.reshape((x.shape[0] // nlogs, nlogs) + x.shape[1:]), 1, 0
+            ),
+            state,
+        )
+
+    def merge(stacked: PyTree) -> PyTree:
+        return jax.tree.map(
+            lambda x: jnp.moveaxis(x, 0, 1).reshape(
+                (x.shape[0] * x.shape[1],) + x.shape[2:]
+            ),
+            stacked,
+        )
+
+    return split, merge
+
+
+def _div_arg0(d: Dispatch, nlogs: int, name: str) -> Dispatch:
+    """Wrap a Dispatch so args[0] is divided by L before each op: the
+    partition-local addressing `k → k // L` of the congruence partition."""
+
+    def wrap(f):
+        def g(s, a):
+            return f(s, a.at[0].set(a[0] // nlogs))
+
+        return g
+
+    return dataclasses.replace(
+        d,
+        name=name,
+        write_ops=tuple(wrap(f) for f in d.write_ops),
+        read_ops=tuple(wrap(f) for f in d.read_ops),
+    )
+
+
+def _check_divisible(n: int, nlogs: int, what: str) -> None:
+    if nlogs < 1:
+        raise ValueError("need at least one log")
+    if n % nlogs:
+        raise ValueError(
+            f"{what}={n} must be a multiple of nlogs={nlogs} for the "
+            f"congruence partition (pad {what} up)"
+        )
+
+
+def make_partitioned_hashmap(
+    n_keys: int, nlogs: int, prefill_value: int | None = None
+) -> PartitionedModel:
+    """Key-congruence partition of the dense hashmap: log l owns keys
+    ≡ l (mod L); each partition is itself a dense hashmap of K/L slots."""
+    _check_divisible(n_keys, nlogs, "n_keys")
+    full = make_hashmap(n_keys, prefill_value)
+    sub = _div_arg0(
+        make_hashmap(n_keys // nlogs, prefill_value),
+        nlogs,
+        f"hashmap{n_keys}sub{nlogs}",
+    )
+    split, merge = _congruence_split(nlogs)
+    return PartitionedModel(full, sub, nlogs, split, merge)
+
+
+def make_partitioned_sortedset(n_keys: int, nlogs: int) -> PartitionedModel:
+    """Key-congruence partition of the ordered set. Single-key writes
+    (insert/remove) act on one partition; order-statistic reads
+    (range-count/rank) span partitions and therefore always run against
+    the merged full state — exactly why the reference requires multi-key
+    ops to share a log or sync (`cnr/src/lib.rs:123-137`)."""
+    _check_divisible(n_keys, nlogs, "n_keys")
+    full = make_sortedset(n_keys)
+    sub = _div_arg0(
+        make_sortedset(n_keys // nlogs),
+        nlogs,
+        f"sortedset{n_keys}sub{nlogs}",
+    )
+    split, merge = _congruence_split(nlogs)
+    return PartitionedModel(full, sub, nlogs, split, merge)
+
+
+def make_partitioned_memfs(
+    n_files: int, n_blocks: int, nlogs: int
+) -> PartitionedModel:
+    """Per-file partition of the in-memory FS (the nrfs `fd - 1` LogMapper,
+    `benches/nrfs.rs:25-39`): log l owns files ≡ l (mod L)."""
+    _check_divisible(n_files, nlogs, "n_files")
+    full = make_memfs(n_files, n_blocks)
+    sub = _div_arg0(
+        make_memfs(n_files // nlogs, n_blocks),
+        nlogs,
+        f"memfs{n_files}x{n_blocks}sub{nlogs}",
+    )
+    split, merge = _congruence_split(nlogs)
+    return PartitionedModel(full, sub, nlogs, split, merge)
