@@ -103,9 +103,14 @@ def main():
         jax.block_until_ready((log, states, out))
         return log, states
 
-    log, states = run(0, args.warmup, log, states)  # compile + warm
+    from node_replication_tpu.utils.trace import get_tracer
+    from node_replication_tpu.utils.trace import span as trace_span
+
+    with trace_span("bench-warmup", steps=args.warmup):
+        log, states = run(0, args.warmup, log, states)  # compile + warm
     start = time.perf_counter()
-    log, states = run(args.warmup, T, log, states)
+    with trace_span("bench-measure", steps=args.steps):
+        log, states = run(args.warmup, T, log, states)
     elapsed = time.perf_counter() - start
 
     # executed dispatches: every replica replays the full appended span,
@@ -113,6 +118,11 @@ def main():
     per_step = R * span + R * Br
     total = per_step * args.steps
     value = total / elapsed
+    get_tracer().emit(
+        "bench", replicas=R, steps=args.steps, elapsed_s=elapsed,
+        dispatches=total, ops_per_sec=value,
+        pallas=bool(args.pallas),
+    )
     print(
         json.dumps(
             {

@@ -20,6 +20,12 @@ def main():
     p.add_argument("-d", "--dist", choices=["uniform", "skewed"],
                    default="uniform")
     p.add_argument("--keys", type=int, default=None)
+    p.add_argument("--cmp", action="store_true",
+                   help="also run the non-NR comparison systems "
+                        "(mutex-guarded map, per-thread partitioned maps) "
+                        "under the same thread count / write ratio — the "
+                        "reference's comparison feature "
+                        "(benches/hashmap_comparisons.rs)")
     args = finish_args(p.parse_args())
     keys = args.keys or (1 << 20 if args.full else 10_000)
     R = args.replicas[0]
@@ -83,6 +89,59 @@ def main():
           f"(reads {rd / args.duration / 1e6:.2f}, "
           f"writes {wr / args.duration / 1e6:.2f})")
     e.close()
+
+    if args.cmp:
+        # Apples-to-apples: ALL systems measure pure-C++ loops (the
+        # Python-thread CLI loop above crosses the FFI per op and measures
+        # binding overhead, not the engine). NR runs its in-engine bench
+        # loop; mutex/partitioned run the comparison loops.
+        import csv
+        import os
+
+        from node_replication_tpu.native import bench_cmp
+
+        n_threads = args.readers + args.writers
+        write_pct = round(100 * args.writers / max(n_threads, 1))
+        dur_ms = int(args.duration * 1000)
+        rows = []
+
+        def record(system, total, per):
+            mops = total / args.duration / 1e6
+            print(f">> hashbench/{system} t={n_threads} "
+                  f"wr={write_pct}%: {mops:.2f} Mops "
+                  f"(min {per.min() / args.duration / 1e6:.2f}, "
+                  f"max {per.max() / args.duration / 1e6:.2f})")
+            for t, ops in enumerate(per):
+                rows.append({
+                    "name": f"hashbench/{system}", "rs": R, "ls": 1,
+                    "tm": "none", "batch": 32, "threads": n_threads,
+                    "duration": args.duration, "thread_id": t,
+                    "core_id": t, "second": -1, "ops": int(ops),
+                    "dispatches": int(ops),
+                })
+
+        e2 = NativeEngine(MODEL_HASHMAP, keys, n_replicas=R,
+                          log_capacity=1 << 18)
+        tpr = max(1, n_threads // R)
+        total, per, _ = e2.bench_hashmap(
+            threads_per_replica=tpr, write_pct=write_pct, keyspace=keys,
+            duration_ms=dur_ms,
+        )
+        record("nr", total, per)
+        e2.close()
+        for system in ("mutex", "partitioned"):
+            total, per = bench_cmp(
+                system, n_threads, write_pct, keys, duration_ms=dur_ms
+            )
+            record(system, total, per)
+        os.makedirs(args.out_dir, exist_ok=True)
+        path = os.path.join(args.out_dir, "scaleout_benchmarks.csv")
+        fresh = not os.path.exists(path)
+        with open(path, "a", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+            if fresh:
+                w.writeheader()
+            w.writerows(rows)
 
 
 if __name__ == "__main__":

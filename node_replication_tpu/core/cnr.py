@@ -39,6 +39,7 @@ from node_replication_tpu.core.replica import (
     states_equal,
 )
 from node_replication_tpu.ops.encoding import Dispatch, apply_read, encode_ops
+from node_replication_tpu.utils.trace import get_tracer
 
 logger = logging.getLogger("node_replication_tpu")
 
@@ -291,14 +292,20 @@ class MultiLogReplicated:
 
     def _watchdog(self, rounds: int, log_idx: int, where: str) -> int:
         rounds += 1
-        if rounds == WARN_ROUNDS:
+        # Re-warn every WARN_ROUNDS forever, like the reference's per-log
+        # GC starvation callback (`cnr/src/log.rs:505-515`).
+        if rounds % WARN_ROUNDS == 0:
             lt = np.asarray(self.ml.ltails)[log_idx]
             dormant = int(np.argmin(lt))
+            tail = int(np.asarray(self.ml.tail)[log_idx])
             logger.warning(
                 "cnr replay stalled in %s on log %d after %d rounds; "
                 "dormant replica=%d (ltail=%d, tail=%d)",
-                where, log_idx, rounds, dormant, int(lt[dormant]),
-                int(np.asarray(self.ml.tail)[log_idx]),
+                where, log_idx, rounds, dormant, int(lt[dormant]), tail,
+            )
+            get_tracer().emit(
+                "watchdog", where=where, log=log_idx, rounds=rounds,
+                dormant=dormant, ltail=int(lt[dormant]), tail=tail,
             )
             if self.gc_callback is not None:
                 self.gc_callback(log_idx, dormant)

@@ -113,12 +113,18 @@ def load() -> ctypes.CDLL:
     lib.nr_bench_hashmap.restype = c.c_uint64
     lib.nr_bench_hashmap.argtypes = [
         c.c_void_p, c.c_int, c.c_int, c.c_int64, c.c_int, c.c_int,
-        c.c_uint64, u64p,
+        c.c_uint64, u64p, u64p, c.c_int,
     ]
     lib.nr_bench_log_append.restype = c.c_uint64
     lib.nr_bench_log_append.argtypes = [c.c_uint64, c.c_int, c.c_int, c.c_int]
     lib.nr_bench_rwlock.restype = c.c_uint64
     lib.nr_bench_rwlock.argtypes = [c.c_int, c.c_int, c.c_int, u64p]
+    # comparison baselines (non-NR systems under the same workload loop)
+    for fn in (lib.nr_bench_cmp_mutex, lib.nr_bench_cmp_partitioned):
+        fn.restype = c.c_uint64
+        fn.argtypes = [
+            c.c_int, c.c_int, c.c_int64, c.c_int, c.c_int, c.c_uint64, u64p,
+        ]
 
     _lib = lib
     return lib
@@ -130,6 +136,7 @@ from node_replication_tpu.native.engine import (  # noqa: E402
     MODEL_STACK,
     NativeEngine,
     NativeRwLock,
+    bench_cmp,
 )
 
 __all__ = [
@@ -140,4 +147,5 @@ __all__ = [
     "MODEL_HASHMAP",
     "MODEL_STACK",
     "MODEL_SORTEDSET",
+    "bench_cmp",
 ]

@@ -166,11 +166,17 @@ class NativeEngine:
         batch: int = 32,
         duration_ms: int = 1000,
         seed: int = 1,
-    ) -> tuple[int, np.ndarray]:
+    ) -> tuple[int, np.ndarray, np.ndarray]:
         """In-process measured loop (threads never cross the FFI per op).
-        Returns (total_ops, per_thread_ops)."""
+        Returns (total_ops, per_thread_ops, per_sec_ops) where
+        `per_sec_ops[t, s]` is thread t's completed ops in wall-clock
+        second s — real bins recorded in the loop, the reference's
+        per-(thread, second) CSV granularity
+        (`benches/mkbench.rs:498-552`)."""
         total_threads = self.n_replicas * threads_per_replica
+        max_secs = max(1, -(-duration_ms // 1000))
         per = (ctypes.c_uint64 * total_threads)()
+        per_sec = (ctypes.c_uint64 * (total_threads * max_secs))()
         total = self._lib.nr_bench_hashmap(
             self._h,
             threads_per_replica,
@@ -180,8 +186,16 @@ class NativeEngine:
             duration_ms,
             seed,
             per,
+            per_sec,
+            max_secs,
         )
-        return int(total), np.ctypeslib.as_array(per).copy()
+        return (
+            int(total),
+            np.ctypeslib.as_array(per).copy(),
+            np.ctypeslib.as_array(per_sec)
+            .copy()
+            .reshape(total_threads, max_secs),
+        )
 
 
 class NativeRwLock:
@@ -240,3 +254,29 @@ def bench_rwlock(
         n_readers, n_writers, duration_ms, c.byref(writes)
     )
     return int(total), int(writes.value)
+
+
+def bench_cmp(
+    system: str,
+    n_threads: int,
+    write_pct: int,
+    keyspace: int,
+    batch: int = 32,
+    duration_ms: int = 1000,
+    seed: int = 1,
+) -> tuple[int, np.ndarray]:
+    """Non-NR comparison baselines under the same splitmix workload loop
+    as `bench_hashmap` (`benches/hashmap_comparisons.rs:25-176` analog):
+    'mutex' = one std::unordered_map behind a mutex; 'partitioned' = one
+    private map per thread over its key congruence class. Returns
+    (total_ops, per_thread_ops)."""
+    from node_replication_tpu.native import load
+
+    lib = load()
+    fn = {
+        "mutex": lib.nr_bench_cmp_mutex,
+        "partitioned": lib.nr_bench_cmp_partitioned,
+    }[system]
+    per = (ctypes.c_uint64 * n_threads)()
+    total = fn(n_threads, write_pct, keyspace, batch, duration_ms, seed, per)
+    return int(total), np.ctypeslib.as_array(per).copy()

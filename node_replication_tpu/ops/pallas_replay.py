@@ -50,7 +50,11 @@ def _replay_kernel(opc_ref, key_ref, val_ref, val_in, pres_in, val_out,
         # opcode/key/value live in SMEM: Mosaic requires dynamic-slice
         # indices to come from scalar memory, not VMEM loads
         opcode = opc_ref[i]
+        # floored mod (matching the generic model's non-negative `%`):
+        # lax.rem truncates toward zero, so adjust negatives or a negative
+        # key would index a negative VMEM row
         k = jax.lax.rem(key_ref[i], jnp.int32(n_keys))
+        k = jnp.where(k < 0, k + jnp.int32(n_keys), k)
         v = val_ref[i]
         is_put = opcode == 1
         is_rem = opcode == 2

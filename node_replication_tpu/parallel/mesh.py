@@ -35,14 +35,45 @@ from node_replication_tpu.core.multilog import MultiLogState
 
 
 class ReplicaStrategy(enum.Enum):
-    """How many replicas and where (`benches/mkbench.rs:321-362`). ONE —
-    one replica on one chip; PER_DEVICE — one replica shard per chip (the
-    'Socket'/NUMA-node analog); PER_CORE — replicas sharded over every core
-    of every chip (the 'L1'/PerThread analog, i.e. the full mesh)."""
+    """Replica-shard placement granularity (`ReplicaStrategy`,
+    `benches/mkbench.rs:321-362`): the reference's One/Socket/L1.../
+    PerThread ladder mapped onto the TPU hierarchy (device → host →
+    slice, `parallel/topology.py`).
+
+    ONE — the whole fleet on a single device, un-sharded (the reference's
+    `One`: one replica, every thread shares it).
+    PER_HOST — one replica shard per host, placed on each host's first
+    device (the `Socket`/NUMA-node analog: shards communicate over DCN).
+    PER_DEVICE — one replica shard on every device (the
+    `L1`/`PerThread` analog: the full mesh, shards communicate over ICI).
+
+    Consumed by `strategy_devices()` → `ShardedRunner` /
+    `ScaleBenchBuilder.replica_strategies()`.
+    """
 
     ONE = "one"
+    PER_HOST = "per_host"
     PER_DEVICE = "per_device"
-    PER_CORE = "per_core"
+
+
+def strategy_devices(strategy: ReplicaStrategy, topo=None, mapping=None):
+    """Ordered device list realizing a ReplicaStrategy (the
+    `replica_core_allocation` analog, `benches/mkbench.rs:838-945`):
+    topology walk + ThreadMapping placement pick which devices host
+    replica shards."""
+    from node_replication_tpu.parallel.topology import (
+        MachineTopology,
+        ThreadMapping,
+    )
+
+    topo = topo or MachineTopology()
+    mapping = mapping or ThreadMapping.SEQUENTIAL
+    if strategy == ReplicaStrategy.ONE:
+        return topo.allocate(mapping, 1)
+    if strategy == ReplicaStrategy.PER_HOST:
+        hosts = sorted({i.process for i in topo.infos})
+        return [topo.devices_on_host(p)[0] for p in hosts]
+    return topo.allocate(mapping, topo.n_devices())
 
 
 def make_mesh(

@@ -208,3 +208,61 @@ class TestShardedRunner:
 
         with pytest.raises(ValueError, match="not divisible"):
             ShardedRunner(make_hashmap(64), 6, 1, 1, n_devices=4)
+
+
+class TestReplicaStrategy:
+    def test_strategy_devices_granularities(self):
+        import jax
+
+        from node_replication_tpu.parallel.mesh import (
+            ReplicaStrategy,
+            strategy_devices,
+        )
+
+        assert len(strategy_devices(ReplicaStrategy.ONE)) == 1
+        # single-host CPU mesh: PER_HOST collapses to one device
+        assert len(strategy_devices(ReplicaStrategy.PER_HOST)) == 1
+        assert len(strategy_devices(ReplicaStrategy.PER_DEVICE)) == len(
+            jax.devices()
+        )
+
+    def test_sharded_runner_strategy_placement(self):
+        from node_replication_tpu.harness import ShardedRunner
+        from node_replication_tpu.parallel.mesh import ReplicaStrategy
+
+        r = ShardedRunner(make_hashmap(64), 16, 2, 2,
+                          log_capacity=1 << 10,
+                          strategy=ReplicaStrategy.PER_DEVICE)
+        assert r.mesh.devices.size == 8
+        assert r.name == "nr-mesh8-per_device"
+        r1 = ShardedRunner(make_hashmap(64), 16, 2, 2,
+                           log_capacity=1 << 10,
+                           strategy=ReplicaStrategy.ONE)
+        assert r1.mesh.devices.size == 1
+
+    def test_sweep_over_strategies(self, tmp_path):
+        from node_replication_tpu.parallel.mesh import ReplicaStrategy
+
+        res = (
+            ScaleBenchBuilder(
+                lambda: make_hashmap(64), "strat", WorkloadSpec(keyspace=64)
+            )
+            .replicas([8])
+            .batches([4])
+            .systems(["sharded"])
+            .replica_strategies(
+                [ReplicaStrategy.ONE, ReplicaStrategy.PER_DEVICE]
+            )
+            .duration(0.1)
+            .out_dir(str(tmp_path))
+            .run()
+        )
+        assert len(res) == 2
+        names = {r.name for r in res}
+        assert names == {"nr-mesh1-one", "nr-mesh8-per_device"}
+        # tm column carries the strategy
+        import csv
+
+        with open(tmp_path / "scaleout_benchmarks.csv") as f:
+            tms = {row["tm"] for row in csv.DictReader(f)}
+        assert tms == {"one", "per_device"}

@@ -386,7 +386,7 @@ class TestBenchEntryPoints:
         with NativeEngine(
             MODEL_HASHMAP, 1024, n_replicas=2, log_capacity=1 << 14
         ) as e:
-            total, per = e.bench_hashmap(
+            total, per, per_sec = e.bench_hashmap(
                 threads_per_replica=2,
                 write_pct=20,
                 keyspace=1024,
@@ -395,6 +395,10 @@ class TestBenchEntryPoints:
             assert total > 0
             assert len(per) == 4
             assert sum(per) == total
+            # per-second bins are real records, not a post-hoc division:
+            # they must sum to each thread's total
+            assert per_sec.shape[0] == 4
+            assert (per_sec.sum(axis=1) == per).all()
             e.sync()
             assert e.replicas_equal()
 
@@ -469,3 +473,56 @@ class TestNativeSortedSet:
                 t.join()
             e.sync()
             assert e.replicas_equal()
+
+    def test_cnr_multikey_read_sees_all_logs(self):
+        # ADVICE r1: SS_RANGE_COUNT / SS_RANK aggregate over many keys, so
+        # in CNR mode they conflict with writes on EVERY log — the read
+        # path must sync all logs, not just the one mapped by args[0]
+        # (LogMapper contract, cnr/src/lib.rs:123-137).
+        from node_replication_tpu.native import MODEL_SORTEDSET
+
+        with NativeEngine(MODEL_SORTEDSET, 256, n_replicas=2,
+                          log_capacity=1 << 12, nlogs=4) as e:
+            t0 = e.register(0)
+            t1 = e.register(1)
+            for k in range(16):  # keys 0..15 spread over all 4 logs
+                e.execute_mut((1, k), t0)
+            # replica 1 has combined nothing; an aggregate read must still
+            # observe every insert (args[0]=0 maps to log 0 only).
+            assert e.execute((2, 0, 256), t1) == 16  # range_count
+            assert e.execute((3, 256), t1) == 16  # rank
+
+    def test_cnr_mixed_log_batch_rejected(self):
+        # A batch whose ops map to different logs violates the one-log-
+        # per-combine contract; the engine returns rc=-2 and the binding
+        # raises instead of returning garbage responses.
+        import pytest
+
+        from node_replication_tpu.native import MODEL_SORTEDSET
+
+        with NativeEngine(MODEL_SORTEDSET, 256, n_replicas=1,
+                          log_capacity=1 << 12, nlogs=4) as e:
+            tok = e.register(0)
+            with pytest.raises(ValueError):
+                e.execute_mut_batch([(1, 0), (1, 1)], tok)  # logs 0 and 1
+
+
+class TestComparisonBaselines:
+    def test_cmp_systems_run_and_count(self):
+        # Non-NR baselines behind the same workload loop
+        # (`benches/hashmap_comparisons.rs:25-176` analog).
+        from node_replication_tpu.native import bench_cmp
+
+        for system in ("mutex", "partitioned"):
+            total, per = bench_cmp(system, 2, 50, 1024, duration_ms=100)
+            assert total > 0
+            assert len(per) == 2
+            assert sum(per) == total
+
+    def test_cmp_unknown_system_rejected(self):
+        import pytest
+
+        from node_replication_tpu.native import bench_cmp
+
+        with pytest.raises(KeyError):
+            bench_cmp("flurry", 2, 50, 1024, duration_ms=10)

@@ -103,3 +103,24 @@ class TestReplayKernel:
             opc, args[:, 0], args[:, 1], st["values"], st["present"]
         )
         assert np.all(np.asarray(values)[3, :] == 9)
+
+
+class TestNegativeKeys:
+    def test_negative_key_matches_generic_floored_mod(self):
+        # ADVICE r1: lax.rem truncates toward zero; the kernel must floor
+        # like the generic model's `%` or a negative key indexes a
+        # negative VMEM row.
+        R, W, K = 2, 4, 16
+        replay = make_hashmap_replay(K, R, W, tile_r=2, interpret=True)
+        opc = jnp.asarray([1, 1, 1, 0], jnp.int32)
+        keys = jnp.asarray([-1, -16, 3, 0], jnp.int32)
+        vals = jnp.asarray([111, 222, 333, 0], jnp.int32)
+        st = pallas_hashmap_state(K, R)
+        values, present, _ = replay(
+            opc, keys, vals, st["values"], st["present"]
+        )
+        v = np.asarray(values)
+        # floored: -1 % 16 = 15, -16 % 16 = 0
+        assert np.all(v[15, :] == 111)
+        assert np.all(v[0, :] == 222)
+        assert np.all(v[3, :] == 333)
