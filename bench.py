@@ -12,13 +12,22 @@ the reference's definition of replayed work, `nr/src/log.rs:473-524`) plus
 every read dispatched against a replica (reads never enter the log,
 `nr/src/replica.rs:483-497`). Appends are not counted.
 
-The whole workload is generated on device up front; the measured loop is
-step-call + slice only (host→device transfers through the tunnel cost
-~100ms each and would otherwise dominate).
+Measurement methodology (round 3): duration-based repeats, fenced by a
+data-dependent D2H readback (`utils/fence.py` — `jax.block_until_ready`
+does NOT wait for execution on the tunneled axon platform, which made the
+round-1/2 numbers dispatch-rate fiction). A calibration pass sizes the
+per-repeat step count to cover `--min-time` seconds of device work; each
+of `--repeats` repeats then times that many steps (async-dispatched,
+donated buffers, one real fence at the end) and the JSON value is the
+MEDIAN across repeats with the min→max spread reported in `spread_pct`.
+Step inputs cycle through `--steps` pre-generated batches resident on
+device, so the measured loop never transfers host data.
 """
 
 import argparse
 import json
+import math
+import statistics
 import sys
 import time
 
@@ -28,6 +37,7 @@ import jax.numpy as jnp
 from node_replication_tpu import LogSpec, log_init, make_step
 from node_replication_tpu.core.replica import replicate_state
 from node_replication_tpu.models import HM_GET, HM_PUT, make_hashmap
+from node_replication_tpu.utils.fence import fence
 
 
 def main():
@@ -36,8 +46,12 @@ def main():
     p.add_argument("--keys", type=int, default=10_000)
     p.add_argument("--writes-per-replica", type=int, default=1)
     p.add_argument("--reads-per-replica", type=int, default=1)
-    p.add_argument("--steps", type=int, default=60)
-    p.add_argument("--warmup", type=int, default=6)
+    p.add_argument("--steps", type=int, default=64,
+                   help="distinct pre-generated step inputs (cycled)")
+    p.add_argument("--repeats", type=int, default=5,
+                   help="timed repeats; the JSON value is their median")
+    p.add_argument("--min-time", type=float, default=1.0,
+                   help="minimum seconds of device work per repeat")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--pallas", action="store_true",
                    help="hand-tiled Pallas replay kernel instead of the "
@@ -70,57 +84,75 @@ def main():
         step = make_step(d, spec, Bw, Br)
         states = replicate_state(d.init_state(), R)
 
-    T = args.steps + args.warmup
+    S = args.steps
 
     @jax.jit
     def gen(key):
         kk, kv, kr = jax.random.split(key, 3)
-        wr_args = jnp.zeros((T, R, Bw, 3), jnp.int32)
+        wr_args = jnp.zeros((S, R, Bw, 3), jnp.int32)
         wr_args = wr_args.at[..., 0].set(
-            jax.random.randint(kk, (T, R, Bw), 0, args.keys, jnp.int32)
+            jax.random.randint(kk, (S, R, Bw), 0, args.keys, jnp.int32)
         )
         wr_args = wr_args.at[..., 1].set(
-            jax.random.randint(kv, (T, R, Bw), 0, 1 << 20, jnp.int32)
+            jax.random.randint(kv, (S, R, Bw), 0, 1 << 20, jnp.int32)
         )
-        rd_args = jnp.zeros((T, R, Br, 3), jnp.int32)
+        rd_args = jnp.zeros((S, R, Br, 3), jnp.int32)
         rd_args = rd_args.at[..., 0].set(
-            jax.random.randint(kr, (T, R, Br), 0, args.keys, jnp.int32)
+            jax.random.randint(kr, (S, R, Br), 0, args.keys, jnp.int32)
         )
         return wr_args, rd_args
 
-    wr_args, rd_args = gen(jax.random.PRNGKey(args.seed))
+    wr_all, rd_all = gen(jax.random.PRNGKey(args.seed))
+    # pre-split into per-step device arrays so the measured loop does no
+    # slicing work at all — just step dispatch
+    wr_steps = [wr_all[t] for t in range(S)]
+    rd_steps = [rd_all[t] for t in range(S)]
     wr_opc = jnp.full((R, Bw), HM_PUT, jnp.int32)
     rd_opc = jnp.full((R, Br), HM_GET, jnp.int32)
-    jax.block_until_ready((wr_args, rd_args))
+    fence(wr_steps, rd_steps)
 
-    def run(t0, t1, log, states):
+    def run(n, log, states):
         out = None
-        for t in range(t0, t1):
+        for i in range(n):
+            t = i % S
             log, states, wr_resps, rd_resps = step(
-                log, states, wr_opc, wr_args[t], rd_opc, rd_args[t]
+                log, states, wr_opc, wr_steps[t], rd_opc, rd_steps[t]
             )
             out = (wr_resps, rd_resps)
-        jax.block_until_ready((log, states, out))
+        # the real barrier: block_until_ready does not wait on this
+        # platform (see utils/fence.py)
+        fence(log, states, out)
         return log, states
 
     from node_replication_tpu.utils.trace import get_tracer
     from node_replication_tpu.utils.trace import span as trace_span
 
-    with trace_span("bench-warmup", steps=args.warmup):
-        log, states = run(0, args.warmup, log, states)  # compile + warm
-    start = time.perf_counter()
-    with trace_span("bench-measure", steps=args.steps):
-        log, states = run(args.warmup, T, log, states)
-    elapsed = time.perf_counter() - start
+    per_step = R * span + R * Br  # executed dispatches per step
 
-    # executed dispatches: every replica replays the full appended span,
-    # plus per-replica read batches.
-    per_step = R * span + R * Br
-    total = per_step * args.steps
-    value = total / elapsed
+    with trace_span("bench-warmup", steps=S):
+        log, states = run(S, log, states)  # compile + warm
+
+    # calibrate: size the per-repeat step count to cover --min-time
+    cal = max(S, 32)
+    t0 = time.perf_counter()
+    log, states = run(cal, log, states)
+    t_step = (time.perf_counter() - t0) / cal
+    n_steps = max(cal, math.ceil(args.min_time / max(t_step, 1e-9)))
+
+    values = []
+    with trace_span("bench-measure", steps=n_steps * args.repeats):
+        for _ in range(args.repeats):
+            start = time.perf_counter()
+            log, states = run(n_steps, log, states)
+            elapsed = time.perf_counter() - start
+            values.append(per_step * n_steps / elapsed)
+
+    value = statistics.median(values)
+    spread_pct = 100.0 * (max(values) - min(values)) / value
     get_tracer().emit(
-        "bench", replicas=R, steps=args.steps, elapsed_s=elapsed,
-        dispatches=total, ops_per_sec=value,
+        "bench", replicas=R, steps=n_steps * args.repeats,
+        repeats=args.repeats, steps_per_repeat=n_steps,
+        ops_per_sec=value, spread_pct=spread_pct,
         pallas=bool(args.pallas),
     )
     print(
@@ -130,13 +162,18 @@ def main():
                 "value": round(value, 1),
                 "unit": "ops/sec",
                 "vs_baseline": round(value / 1e7, 3),
+                "repeats": args.repeats,
+                "spread_pct": round(spread_pct, 2),
+                "steps_timed": n_steps * args.repeats,
             }
         )
     )
     print(
-        f"# {args.steps} steps in {elapsed:.3f}s | {R} replicas x "
+        f"# median of {args.repeats} repeats x {n_steps} steps "
+        f"(~{per_step * n_steps / value:.2f}s/repeat) | {R} replicas x "
         f"(span {span} replayed + {Br} reads) = {per_step} dispatches/step "
-        f"| device={jax.devices()[0].device_kind}",
+        f"| spread {spread_pct:.1f}% {[f'{v:.4g}' for v in values]} | "
+        f"device={jax.devices()[0].device_kind}",
         file=sys.stderr,
     )
 

@@ -19,6 +19,8 @@ from common import base_parser, finish_args
 import jax
 import jax.numpy as jnp
 
+from node_replication_tpu.utils.fence import fence
+
 
 def device_append_bench(capacity: int, batch: int, duration_s: float,
                         chain: int = 64) -> float:
@@ -41,13 +43,22 @@ def device_append_bench(capacity: int, batch: int, duration_s: float,
         return log._replace(tail=jnp.zeros((), jnp.int64))
 
     log = chain_append(log)  # compile
-    jax.block_until_ready(log)
+    fence(log)
+    # Amortize the fence: one D2H readback costs a tunnel RTT (~100ms),
+    # so fencing every chain would measure the RTT, not the appends.
+    # Dispatch k chains per fence and grow k until a fenced round is
+    # RTT-dominated no more (>= ~0.5s).
     n = 0
+    k = 1
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < duration_s:
-        log = chain_append(log)
-        jax.block_until_ready(log)
-        n += chain * batch
+        r0 = time.perf_counter()
+        for _ in range(k):
+            log = chain_append(log)
+        fence(log)
+        n += k * chain * batch
+        if time.perf_counter() - r0 < 0.5:
+            k *= 2
     return n / (time.perf_counter() - t0)
 
 

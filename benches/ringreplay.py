@@ -32,6 +32,7 @@ def main():
     from node_replication_tpu.ops.encoding import apply_write
     from node_replication_tpu.parallel import make_mesh
     from node_replication_tpu.parallel.collectives import make_ring_exec
+    from node_replication_tpu.utils.fence import fence
 
     P_ = args.devices or len(jax.devices())
     W = args.window - args.window % P_
@@ -64,12 +65,15 @@ def main():
     for name, fn in (("ring", lambda: ring(opc, args_arr, states)),
                      ("single", lambda: seq_jit(opc, args_arr, states))):
         out = fn()
-        jax.block_until_ready(out)
+        fence(out)
         t0 = time.perf_counter()
         reps = 3
+        # enqueue all reps, fence once: the device executes in order, so
+        # the final fence covers every rep and the ~100ms readback RTT is
+        # amortized over all of them instead of padding each arm
         for _ in range(reps):
             out = fn()
-            jax.block_until_ready(out)
+        fence(out)
         dt = (time.perf_counter() - t0) / reps
         print(f">> ringreplay/{name} P={P_} W={W} R={R}: "
               f"{R * W / dt / 1e6:.2f} M replays/s ({dt * 1e3:.1f} ms)")

@@ -96,7 +96,12 @@ def measure_step_runner(
     """Drive a step runner for ~`duration_s`, bucketing op counts by
     wall-clock second (the per-second capture of
     `benches/mkbench.rs:755-761`). Steps cycle over the pre-staged
-    workload."""
+    workload.
+
+    `chunk` is the INITIAL steps-per-fence; it doubles whenever a fenced
+    round finishes in under ~0.25s so the fence's D2H readback RTT
+    (~100ms through the tunnel) is amortized instead of dominating fast
+    runners (the real barrier is a readback — see `utils/fence.py`)."""
     S = wr_opc.shape[0]
     runner.prepare(wr_opc, wr_args, rd_opc, rd_args)
     for s in range(min(warmup_steps, S)):
@@ -110,6 +115,7 @@ def measure_step_runner(
     idx = 0
     t0 = time.perf_counter()
     while True:
+        r0 = time.perf_counter()
         for _ in range(chunk):
             runner.run_step(idx % S)
             idx += 1
@@ -121,6 +127,8 @@ def measure_step_runner(
         buckets[int(now - t0)] = buckets.get(int(now - t0), 0) + done_client
         if now - t0 >= duration_s:
             break
+        if now - r0 < 0.25:
+            chunk *= 2
     dur = time.perf_counter() - t0
     tracer = get_tracer()
     if tracer.enabled:

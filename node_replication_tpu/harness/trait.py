@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from node_replication_tpu.core.log import LogSpec, log_init
+from node_replication_tpu.utils.fence import fence
 from node_replication_tpu.core.multilog import (
     MultiLogSpec,
     make_multilog_step,
@@ -68,7 +69,11 @@ class FleetRunner(abc.ABC):
         """Execute step `s` (asynchronously; call `block()` to fence)."""
 
     def block(self) -> None:
-        """Fence outstanding device work."""
+        """Fence outstanding device work. Implementations MUST use
+        `utils.fence.fence` (a data-dependent D2H readback):
+        `jax.block_until_ready` does not wait for execution on the
+        tunneled axon platform, and fencing with it turns every timed
+        region into a dispatch-rate fiction (round-3 discovery)."""
 
     def state_dump(self, rid: int = 0):
         """Replica state as a host pytree (the verify hook)."""
@@ -114,7 +119,7 @@ class ReplicatedRunner(FleetRunner):
         )
 
     def block(self):
-        jax.block_until_ready((self.log, self.states))
+        fence(self.log, self.states)
 
     def state_dump(self, rid: int = 0):
         return jax.tree.map(lambda a: np.asarray(a[rid]), self.states)
@@ -215,7 +220,7 @@ class MultiLogRunner(FleetRunner):
         )
 
     def block(self):
-        jax.block_until_ready((self.ml, self.states))
+        fence(self.ml, self.states)
 
     def state_dump(self, rid: int = 0):
         return jax.tree.map(lambda a: np.asarray(a[rid]), self.states)
@@ -262,7 +267,7 @@ class PartitionedRunner(FleetRunner):
         )
 
     def block(self):
-        jax.block_until_ready(self.states)
+        fence(self.states)
 
     def state_dump(self, rid: int = 0):
         return jax.tree.map(lambda a: np.asarray(a[rid]), self.states)
@@ -314,7 +319,7 @@ class ConcurrentDsRunner(FleetRunner):
         )
 
     def block(self):
-        jax.block_until_ready(self.state)
+        fence(self.state)
 
     def state_dump(self, rid: int = 0):
         return jax.tree.map(np.asarray, self.state)
