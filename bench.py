@@ -12,6 +12,14 @@ the reference's definition of replayed work, `nr/src/log.rs:473-524`) plus
 every read dispatched against a replica (reads never enter the log,
 `nr/src/replica.rs:483-497`). Appends are not counted.
 
+Replay engine (`--path`): the default is the *combined* window replay —
+`Dispatch.window_apply` computes each window as one parallel reduction
+(sort + predecessor lookup + dense merge), bit-identical to the sequential
+fold (tests/test_window.py) but ~1000x faster at this config than the
+generic per-entry scan (measured r3 on TPU v5e: 3.9 ms/step combined vs
+20.3 s/step scan at R=4096, K=10000). `--path scan` measures the faithful
+per-entry analog of the reference's replay loop.
+
 Measurement methodology (round 3): duration-based repeats, fenced by a
 data-dependent D2H readback (`utils/fence.py` — `jax.block_until_ready`
 does NOT wait for execution on the tunneled axon platform, which made the
@@ -53,11 +61,24 @@ def main():
     p.add_argument("--min-time", type=float, default=1.0,
                    help="minimum seconds of device work per repeat")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--path", choices=["auto", "combined", "scan", "pallas"],
+                   default="auto",
+                   help="replay engine: 'combined' = Dispatch.window_apply "
+                        "parallel reduction (sort + merge; the TPU-first "
+                        "fast path), 'scan' = generic vmapped lax.scan "
+                        "(one sequential apply per entry — the faithful "
+                        "analog of the reference's replay loop, ~1000x "
+                        "slower at this config), 'pallas' = hand-tiled "
+                        "VMEM kernel (needs a small keyspace, e.g. "
+                        "--keys 1024), 'auto' = combined when the model "
+                        "provides window_apply")
     p.add_argument("--pallas", action="store_true",
-                   help="hand-tiled Pallas replay kernel instead of the "
-                        "generic vmapped-scan path; VMEM-bound, needs a "
-                        "small keyspace (e.g. --keys 1024)")
+                   help="alias for --path pallas")
     args = p.parse_args()
+    if args.pallas:
+        if args.path not in ("auto", "pallas"):
+            p.error(f"--pallas conflicts with --path {args.path}")
+        args.path = "pallas"
 
     R, Bw, Br = args.replicas, args.writes_per_replica, args.reads_per_replica
     span = R * Bw
@@ -69,7 +90,7 @@ def main():
     )
     d = make_hashmap(args.keys)
     log = log_init(spec)
-    if args.pallas:
+    if args.path == "pallas":
         from node_replication_tpu.ops.pallas_replay import (
             make_pallas_step,
             pallas_hashmap_state,
@@ -81,7 +102,8 @@ def main():
             sys.exit(f"--pallas config rejected: {e}")
         states = pallas_hashmap_state(args.keys, R)
     else:
-        step = make_step(d, spec, Bw, Br)
+        combined = None if args.path == "auto" else (args.path == "combined")
+        step = make_step(d, spec, Bw, Br, combined=combined)
         states = replicate_state(d.init_state(), R)
 
     S = args.steps
@@ -153,7 +175,7 @@ def main():
         "bench", replicas=R, steps=n_steps * args.repeats,
         repeats=args.repeats, steps_per_repeat=n_steps,
         ops_per_sec=value, spread_pct=spread_pct,
-        pallas=bool(args.pallas),
+        path=args.path,
     )
     print(
         json.dumps(
@@ -165,11 +187,13 @@ def main():
                 "repeats": args.repeats,
                 "spread_pct": round(spread_pct, 2),
                 "steps_timed": n_steps * args.repeats,
+                "path": args.path,
             }
         )
     )
     print(
-        f"# median of {args.repeats} repeats x {n_steps} steps "
+        f"# path={args.path} | median of {args.repeats} repeats x "
+        f"{n_steps} steps "
         f"(~{per_step * n_steps / value:.2f}s/repeat) | {R} replicas x "
         f"(span {span} replayed + {Br} reads) = {per_step} dispatches/step "
         f"| spread {spread_pct:.1f}% {[f'{v:.4g}' for v in values]} | "

@@ -158,6 +158,7 @@ def _exec_one(
     state: PyTree,
     ltail: jax.Array,
     window: int,
+    limit: jax.Array | None = None,
 ):
     """Replay up to `window` entries of `[ltail, tail)` into one replica.
 
@@ -165,18 +166,26 @@ def _exec_one(
     spin on `alivef` then `dispatch_mut`. Here the spin is gone (liveness is
     `pos < tail`) and the loop is a `lax.scan` whose body is one masked
     `apply_write`.
+
+    `limit` (optional) caps how far this replica replays: the effective
+    tail is `min(tail, limit)`. A limited replica is a *dormant* one — it
+    stops consuming the log early, its `ltail` lags, and GC (`head =
+    min(ltails)`) stalls on it exactly as a slow reference replica stalls
+    `advance_head` (`nr/src/log.rs:536-539`).
     """
+    eff_tail = log.tail if limit is None else jnp.minimum(log.tail, limit)
 
     def body(state, j):
         pos = ltail + j
-        active = pos < log.tail
+        active = pos < eff_tail
         idx = (pos & spec.mask).astype(jnp.int32)
         opcode = jnp.where(active, log.opcodes[idx], NOOP)
         state, resp = apply_write(d, state, opcode, log.args[idx])
         return state, resp
 
     state, resps = lax.scan(body, state, jnp.arange(window, dtype=jnp.int64))
-    new_ltail = jnp.minimum(ltail + window, log.tail)
+    new_ltail = jnp.minimum(ltail + window, eff_tail)
+    new_ltail = jnp.maximum(new_ltail, ltail)  # limit below ltail: no-op
     return state, resps, new_ltail
 
 
@@ -186,6 +195,7 @@ def log_exec_all(
     log: LogState,
     states: PyTree,
     window: int,
+    limits: jax.Array | None = None,
 ):
     """Replay a static `window` of pending entries into every replica in
     lock-step (vmapped `_exec_one`), then fold in progress bookkeeping:
@@ -194,12 +204,22 @@ def log_exec_all(
     - `ctail = max(ctail, max(ltails))`   (fetch_max, `nr/src/log.rs:520-523`),
     - `head  = min(ltails)`               (GC, `nr/src/log.rs:536-580`).
 
+    `limits` (optional, int64[R]) caps each replica's replay at
+    `min(tail, limits[r])` — simulated dormant replicas: laggards hold GC
+    back (`head` stalls at their ltail) until a later un-limited call lets
+    them catch up, mirroring `Replica::sync` (`nr/src/replica.rs:469-479`).
+
     Returns `(log, states, resps)` with `resps: int32[R, window]`;
     `resps[r, i]` answers the entry at logical position `old_ltails[r] + i`.
     """
-    states, resps, new_ltails = jax.vmap(
-        lambda s, lt: _exec_one(spec, d, log, s, lt, window)
-    )(states, log.ltails)
+    if limits is None:
+        states, resps, new_ltails = jax.vmap(
+            lambda s, lt: _exec_one(spec, d, log, s, lt, window)
+        )(states, log.ltails)
+    else:
+        states, resps, new_ltails = jax.vmap(
+            lambda s, lt, lim: _exec_one(spec, d, log, s, lt, window, lim)
+        )(states, log.ltails, jnp.asarray(limits, jnp.int64))
     log = log._replace(
         ltails=new_ltails,
         ctail=jnp.maximum(log.ctail, jnp.max(new_ltails)),

@@ -44,6 +44,7 @@ def make_step(
     reads_per_replica: int,
     jit: bool = True,
     donate: bool = True,
+    combined: bool | None = None,
 ):
     """Build `step(log, states, wr_opcodes, wr_args, rd_opcodes, rd_args)`.
 
@@ -58,6 +59,13 @@ def make_step(
     own replay of its own entry — the reference's response-distribution
     contract, `nr/src/replica.rs:584-594`) and `rd_resps[r, j]` answers its
     j-th read. NOOP-padded slots answer 0.
+
+    `combined` selects the replay engine: True = the model's
+    `Dispatch.window_apply` combined replay (one parallel reduction per
+    window instead of a W-long sequential scan; bit-identical semantics),
+    False = the generic vmapped scan, None (default) = combined when the
+    model provides it. Both read the window back from the ring, so the
+    log remains the source of truth either way.
     """
     R = spec.n_replicas
     Bw = int(writes_per_replica)
@@ -68,6 +76,12 @@ def make_step(
         raise ValueError(
             f"step appends {span} entries but log fits {max_batch}; "
             f"grow LogSpec.capacity or shrink the per-step batch"
+        )
+    if combined is None:
+        combined = dispatch.window_apply is not None
+    if combined and dispatch.window_apply is None:
+        raise ValueError(
+            f"combined=True but {dispatch.name} has no window_apply"
         )
 
     def step(log, states, wr_opcodes, wr_args, rd_opcodes, rd_args):
@@ -80,7 +94,27 @@ def make_step(
             span,
         )
         # 3. replay exactly the appended window into every replica.
-        log, states, resps = log_exec_all(spec, dispatch, log, states, span)
+        if combined:
+            # combined replay: gather the appended window from the ring
+            # and apply it as one reduction per replica (vmap keeps the
+            # window-wide sort unbatched — it is shared by the fleet)
+            lanes = jnp.arange(span, dtype=jnp.int64)
+            idx = ((log.tail - span + lanes) & spec.mask).astype(jnp.int32)
+            opc_w = log.opcodes[idx]
+            args_w = log.args[idx]
+            states, resps = jax.vmap(
+                lambda s: dispatch.window_apply(s, opc_w, args_w)
+            )(states)
+            # lock-step cursor bookkeeping (every replica consumed the
+            # span): same lattice updates as log_exec_all
+            new_ltails = jnp.broadcast_to(log.tail, (R,))
+            log = log._replace(
+                ltails=new_ltails, ctail=log.tail, head=log.tail
+            )
+        else:
+            log, states, resps = log_exec_all(
+                spec, dispatch, log, states, span
+            )
         # Replica r's own writes sit at window offsets [r*Bw, (r+1)*Bw).
         own = jnp.arange(R, dtype=jnp.int32)[:, None] * Bw + jnp.arange(
             Bw, dtype=jnp.int32

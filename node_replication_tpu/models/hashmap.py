@@ -68,10 +68,73 @@ def make_hashmap(n_keys: int, prefill_value: int | None = None) -> Dispatch:
             state["present"][k], state["values"][k], jnp.int32(ABSENT)
         )
 
+    def window_apply(state, opcodes, args):
+        """Combined replay of a whole window (see `Dispatch.window_apply`).
+
+        PUT/REMOVE are last-writer-wins per key, so the final state needs
+        only each key's LAST active entry, and a REMOVE's response
+        (was-present) needs only its immediate same-key PREDECESSOR — both
+        parallel computations:
+
+        1. group entries by key with one stable sort,
+        2. presence-before(entry) = predecessor-was-PUT, or the replica's
+           initial presence for each key's first touch,
+        3. merge each key's last write into the dense table (elementwise).
+
+        Bit-identical to folding put/remove over the window in order
+        (differentially tested in tests/test_window.py). Replaces the
+        reference's per-entry replay loop (`nr/src/log.rs:473-524`) with
+        O(W log W) parallel work instead of W sequential scatters.
+        """
+        W = opcodes.shape[0]
+        k = args[:, 0] % n_keys
+        v = args[:, 1]
+        is_put = opcodes == HM_PUT
+        is_rem = opcodes == HM_REMOVE
+        active = is_put | is_rem
+        # inactive slots (NOOP / unknown opcodes) group into a sentinel
+        # bucket past the keyspace so they never touch real keys
+        key_eff = jnp.where(active, k, n_keys).astype(jnp.int64)
+        idx = jnp.arange(W, dtype=jnp.int64)
+        # stable key grouping: one sort key (key, window position)
+        order = jnp.argsort(key_eff * (W + 1) + idx)
+        sk = key_eff[order]
+        same_prev = jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), sk[1:] == sk[:-1]]
+        )
+        prev = jnp.concatenate([order[:1], order[:-1]])
+        # presence just before each entry: its same-key predecessor's
+        # effect, else the replica's initial presence of that key
+        # sentinel index n_keys clamps onto the last real key; harmless
+        # because sentinel slots are never REMOVEs (resp forced to 0)
+        init_present = state["present"].at[
+            sk.astype(jnp.int32)
+        ].get(mode="clip")
+        pres_before = jnp.where(same_prev, is_put[prev], init_present)
+        resp_sorted = jnp.where(
+            is_rem[order], pres_before.astype(jnp.int32), jnp.int32(0)
+        )
+        resps = jnp.zeros((W,), jnp.int32).at[order].set(resp_sorted)
+        # last active entry per key wins (scatter-max of window position;
+        # sentinel bucket absorbs inactive slots)
+        last = (
+            jnp.full((n_keys + 1,), -1, jnp.int64)
+            .at[key_eff].max(idx)[:n_keys]
+        )
+        touched = last >= 0
+        li = jnp.clip(last, 0).astype(jnp.int32)
+        last_is_put = is_put[li]
+        values = jnp.where(
+            touched, jnp.where(last_is_put, v[li], 0), state["values"]
+        )
+        present = jnp.where(touched, last_is_put, state["present"])
+        return {"values": values, "present": present}, resps
+
     return Dispatch(
         name=f"hashmap{n_keys}",
         make_state=make_state,
         write_ops=(put, remove),
         read_ops=(get,),
         arg_width=3,
+        window_apply=window_apply,
     )

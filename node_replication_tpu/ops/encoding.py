@@ -48,6 +48,19 @@ class Dispatch:
     """A replicated data structure: state constructor + pure transitions.
 
     Hashable (frozen, tuples of functions) so it can be a jit static arg.
+
+    `window_apply` (optional) is the *combined replay* fast path:
+    `(state, opcodes[W], args[W, A]) -> (state, resps[W])`, bit-identical
+    to folding `apply_write` over the window in order. Models whose write
+    ops are per-key last-writer-wins (hashmap, sorted set, page tables…)
+    can compute a whole window with one sort + predecessor lookup + one
+    dense merge instead of W sequential scatters — the flat-combining idea
+    (`nr/src/replica.rs:543-595` batches ops to amortize the log CAS)
+    taken to its TPU conclusion: the *application* itself is batched into
+    a parallel reduction, turning the HBM-bound sequential replay scan
+    into a handful of vectorized passes. `core/step.make_step` uses it
+    automatically when present; the generic `lax.scan` path remains for
+    order-dependent models (stack, queue) and divergent-cursor replay.
     """
 
     name: str
@@ -55,6 +68,7 @@ class Dispatch:
     write_ops: tuple
     read_ops: tuple
     arg_width: int = 3
+    window_apply: Callable | None = None
 
     @property
     def n_write_ops(self) -> int:

@@ -147,6 +147,48 @@ class TestExec:
             np.asarray(states["buf"][0]), np.asarray(states["buf"][1])
         )
 
+    def test_limits_make_dormant_replicas_and_stall_gc(self):
+        # `limits` caps per-replica replay (simulated dormancy): the
+        # limited replica's ltail lags, GC stalls on it
+        # (`nr/src/log.rs:536-539`), and an unlimited sync round converges
+        # the fleet (`Replica::sync`, `nr/src/replica.rs:469-479`).
+        spec = small_spec(n_replicas=3)
+        d = make_stack(32)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 3)
+        opc, args, n = push_batch([1, 2, 3, 4])
+        log = log_append(spec, log, opc, args, n)
+        limits = jnp.asarray([0, 2, 4], jnp.int64)
+        log, states, _ = log_exec_all(spec, d, log, states, 4,
+                                      limits=limits)
+        assert list(np.asarray(log.ltails)) == [0, 2, 4]
+        assert int(log.head) == 0  # GC pinned by the dormant replica
+        assert int(log.ctail) == 4
+        assert list(np.asarray(states["top"])) == [0, 2, 4]
+        # sync: unlimited round catches everyone up and releases GC
+        log, states, _ = log_exec_all(spec, d, log, states, 4)
+        assert list(np.asarray(log.ltails)) == [4, 4, 4]
+        assert int(log.head) == 4
+        np.testing.assert_array_equal(
+            np.asarray(states["buf"][0]), np.asarray(states["buf"][2])
+        )
+
+    def test_limit_below_ltail_is_noop(self):
+        # a limit behind a replica's progress must not move it backward
+        spec = small_spec(n_replicas=1)
+        d = make_stack(32)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 1)
+        opc, args, n = push_batch([1, 2])
+        log = log_append(spec, log, opc, args, n)
+        log, states, _ = log_exec_all(spec, d, log, states, 2)
+        assert int(log.ltails[0]) == 2
+        log, states, _ = log_exec_all(
+            spec, d, log, states, 2, limits=jnp.asarray([1], jnp.int64)
+        )
+        assert int(log.ltails[0]) == 2  # unchanged
+        assert list(np.asarray(states["top"])) == [2]
+
     def test_gc_head_is_min_ltail(self):
         # `advance_head` = min over ltails (`nr/src/log.rs:536-580`).
         spec = small_spec(n_replicas=2)

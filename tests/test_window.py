@@ -1,0 +1,169 @@
+"""Combined window replay (`Dispatch.window_apply`) vs the generic scan.
+
+The combined path replaces the W-long sequential replay scan with one
+parallel reduction (sort + predecessor lookup + dense merge). These tests
+pin BIT-identical behavior against folding `apply_write` in order — state,
+write responses, and read responses — across adversarial windows: duplicate
+keys, PUT/REMOVE interleavings, NOOP padding, unknown opcodes, ring wrap,
+and multi-step drives.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from node_replication_tpu import LogSpec, log_init, make_step
+from node_replication_tpu.core.replica import replicate_state
+from node_replication_tpu.models import (
+    HM_GET,
+    HM_PUT,
+    HM_REMOVE,
+    make_hashmap,
+)
+from node_replication_tpu.ops.encoding import apply_write
+
+
+def fold_reference(d, state, opcodes, args):
+    """Host-side ground truth: apply_write folded in window order."""
+    resps = []
+    for i in range(len(opcodes)):
+        state, r = apply_write(d, state, opcodes[i], args[i])
+        resps.append(int(r))
+    return state, resps
+
+
+class TestWindowApplySingle:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_sequential_fold(self, seed):
+        K, W = 13, 64
+        d = make_hashmap(K)
+        rng = np.random.default_rng(seed)
+        # adversarial mix: heavy key collisions, NOOPs, unknown opcode 7
+        opcodes = jnp.asarray(
+            rng.choice([0, HM_PUT, HM_REMOVE, 7], size=W,
+                       p=[0.15, 0.45, 0.3, 0.1]),
+            jnp.int32,
+        )
+        args = jnp.asarray(
+            np.stack(
+                [rng.integers(0, K, W), rng.integers(1, 100, W),
+                 np.zeros(W)], axis=1
+            ),
+            jnp.int32,
+        )
+        state0 = d.init_state()
+        # start from a non-trivial state: some keys pre-present
+        state0["present"] = state0["present"].at[::3].set(True)
+        state0["values"] = state0["values"].at[::3].set(5)
+        ref_state, ref_resps = fold_reference(d, state0, opcodes, args)
+        got_state, got_resps = d.window_apply(state0, opcodes, args)
+        np.testing.assert_array_equal(
+            np.asarray(got_state["values"]), np.asarray(ref_state["values"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_state["present"]),
+            np.asarray(ref_state["present"]),
+        )
+        assert [int(x) for x in got_resps] == ref_resps
+
+    def test_remove_answers_predecessor_not_initial(self):
+        # REMOVE after an in-window PUT answers 1 even if the key started
+        # absent; a second REMOVE answers 0
+        K = 8
+        d = make_hashmap(K)
+        opcodes = jnp.asarray(
+            [HM_PUT, HM_REMOVE, HM_REMOVE, HM_PUT], jnp.int32
+        )
+        args = jnp.asarray(
+            [[3, 9, 0], [3, 0, 0], [3, 0, 0], [3, 11, 0]], jnp.int32
+        )
+        state, resps = d.window_apply(d.init_state(), opcodes, args)
+        assert [int(x) for x in resps] == [0, 1, 0, 0]
+        assert int(state["values"][3]) == 11
+        assert bool(state["present"][3])
+
+    def test_all_noop_window_is_identity(self):
+        K = 4
+        d = make_hashmap(K)
+        state0 = d.init_state()
+        state0["values"] = state0["values"].at[1].set(7)
+        state0["present"] = state0["present"].at[1].set(True)
+        state, resps = d.window_apply(
+            state0, jnp.zeros((8,), jnp.int32), jnp.zeros((8, 3), jnp.int32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state["values"]), np.asarray(state0["values"])
+        )
+        assert not np.any(np.asarray(resps))
+
+
+class TestCombinedStep:
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_step_bit_identical_to_scan_step(self, seed):
+        R, Bw, Br, K, STEPS = 4, 3, 2, 11, 6
+        d = make_hashmap(K)
+        # capacity small enough that the ring wraps during the drive
+        spec = LogSpec(capacity=2 * R * Bw, n_replicas=R, arg_width=3,
+                       gc_slack=R * Bw // 2)
+        rng = np.random.default_rng(seed)
+        s_comb = make_step(d, spec, Bw, Br, jit=True, donate=False,
+                           combined=True)
+        s_scan = make_step(d, spec, Bw, Br, jit=True, donate=False,
+                           combined=False)
+        log_c, st_c = log_init(spec), replicate_state(d.init_state(), R)
+        log_s, st_s = log_init(spec), replicate_state(d.init_state(), R)
+        for _ in range(STEPS):
+            wr_opc = jnp.asarray(
+                rng.choice([0, HM_PUT, HM_REMOVE], size=(R, Bw)), jnp.int32
+            )
+            wr_args = jnp.asarray(
+                rng.integers(0, K, size=(R, Bw, 3)), jnp.int32
+            )
+            rd_opc = jnp.full((R, Br), HM_GET, jnp.int32)
+            rd_args = jnp.asarray(
+                rng.integers(0, K, size=(R, Br, 3)), jnp.int32
+            )
+            log_c, st_c, wr_c, rd_c = s_comb(
+                log_c, st_c, wr_opc, wr_args, rd_opc, rd_args
+            )
+            log_s, st_s, wr_s, rd_s = s_scan(
+                log_s, st_s, wr_opc, wr_args, rd_opc, rd_args
+            )
+            np.testing.assert_array_equal(np.asarray(wr_c), np.asarray(wr_s))
+            np.testing.assert_array_equal(np.asarray(rd_c), np.asarray(rd_s))
+        for leaf_c, leaf_s in zip(jax.tree.leaves(st_c), jax.tree.leaves(st_s)):
+            np.testing.assert_array_equal(np.asarray(leaf_c), np.asarray(leaf_s))
+        for name in ("head", "tail", "ctail"):
+            assert int(getattr(log_c, name)) == int(getattr(log_s, name))
+        np.testing.assert_array_equal(
+            np.asarray(log_c.ltails), np.asarray(log_s.ltails)
+        )
+
+    def test_auto_selects_combined_when_available(self):
+        d = make_hashmap(8)
+        assert d.window_apply is not None
+        spec = LogSpec(capacity=64, n_replicas=2, arg_width=3, gc_slack=8)
+        # default (None) → combined; explicit False → scan; both compile
+        for combined in (None, False):
+            step = make_step(d, spec, 1, 1, jit=True, donate=False,
+                             combined=combined)
+            log, st = log_init(spec), replicate_state(d.init_state(), 2)
+            log, st, wr, rd = step(
+                log, st,
+                jnp.full((2, 1), HM_PUT, jnp.int32),
+                jnp.zeros((2, 1, 3), jnp.int32).at[..., 0].set(3)
+                .at[..., 1].set(9),
+                jnp.full((2, 1), HM_GET, jnp.int32),
+                jnp.zeros((2, 1, 3), jnp.int32).at[..., 0].set(3),
+            )
+            assert int(rd[0, 0]) == 9
+
+    def test_combined_requires_window_apply(self):
+        from node_replication_tpu.models import make_stack
+
+        d = make_stack(16)
+        assert d.window_apply is None
+        spec = LogSpec(capacity=64, n_replicas=1, arg_width=3, gc_slack=8)
+        with pytest.raises(ValueError):
+            make_step(d, spec, 1, 0, combined=True)
