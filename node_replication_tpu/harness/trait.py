@@ -131,96 +131,182 @@ class ReplicatedRunner(FleetRunner):
 
 
 class MultiLogRunner(FleetRunner):
-    """CNR: R replicas behind L key-partitioned logs (`cnr` equivalent).
+    """CNR: R replicas behind L key-hash-partitioned logs (`cnr`
+    equivalent).
 
-    Workload writes are re-keyed onto congruence classes (`key ≡ log (mod
-    L)`) at prepare time — the LogMapper partition made structural so the
-    per-log batches keep static shapes. Pass a `PartitionedModel`
-    (`models/partitioned.py`) to replay all L logs in one vmapped
-    computation (the parallel-combining payoff); without it the replay
-    folds logs sequentially.
+    Routing is SKEW-FAITHFUL (VERDICT r2 #6): every write goes to log
+    `key % L` — the LogMapper hash (`cnr/src/replica.rs:435`) — with NO
+    re-balancing, so a zipf-hot key concentrates its whole conflict class
+    on one log and per-log load imbalance is visible exactly as the
+    reference's CNR experiences it (`benches/hashmap.rs:143-150`). Per-log
+    batches are padded to the stream's worst bucket (static shapes); the
+    per-STEP `counts[s, l]` differ, and `stats()` exposes the per-log
+    appended depths so imbalance can be measured.
+
+    Because `log = key % L`, the routed buckets satisfy the congruence
+    invariant (`key ≡ log (mod L)`) by construction, so a
+    `PartitionedModel` (`models/partitioned.py`) can replay all L logs in
+    one vmapped computation (the parallel-combining payoff) with no key
+    rewriting. Pass `rebalance=True` to opt back into the r2-style
+    balanced congruence re-key (equal buckets; maximizes vmap occupancy
+    at the cost of workload fidelity).
     """
 
     def __init__(self, dispatch: Dispatch, n_replicas: int, nlogs: int,
-                 writes_per_log: int, reads_per_replica: int,
+                 writes_per_replica: int, reads_per_replica: int,
                  log_capacity: int | None = None,
-                 partitioned=None, keyspace: int | None = None):
+                 partitioned=None, keyspace: int | None = None,
+                 rebalance: bool = False):
         self.name = f"cnr{nlogs}" + ("p" if partitioned is not None else "")
         self.dispatch = dispatch
         self.n_replicas = n_replicas
         self.nlogs = nlogs
         self.keyspace = keyspace
-        self.B, self.Br = writes_per_log, reads_per_replica
+        self.rebalance = rebalance
+        self.partitioned = partitioned
+        self.log_capacity = log_capacity
+        self.Bw, self.Br = writes_per_replica, reads_per_replica
+        self.B = None  # per-log pad width; fixed by prepare() from data
+        self.step = None
+
+    def _build(self, B: int):
+        """Instantiate spec/step/state once the per-log pad width is
+        known (prepare time — B is the routed stream's worst bucket)."""
+        self.B = B
         self.spec = MultiLogSpec(
-            nlogs=nlogs,
-            capacity=log_capacity or max(4 * writes_per_log, 1 << 12),
-            n_replicas=n_replicas,
-            arg_width=dispatch.arg_width,
-            gc_slack=min(1024, writes_per_log),
+            nlogs=self.nlogs,
+            capacity=self.log_capacity or max(4 * B, 1 << 12),
+            n_replicas=self.n_replicas,
+            arg_width=self.dispatch.arg_width,
+            gc_slack=min(1024, max(B, 1)),
         )
         self.step = make_multilog_step(
-            dispatch, self.spec, self.B, self.Br, partitioned=partitioned
+            self.dispatch, self.spec, B, self.Br,
+            partitioned=self.partitioned,
         )
         self.ml = multilog_init(self.spec)
-        self.states = replicate_state(dispatch.init_state(), n_replicas)
-        span = nlogs * writes_per_log
-        self.dispatches_per_step = n_replicas * span + n_replicas * self.Br
-        self.client_ops_per_step = span + n_replicas * self.Br
+        self.states = replicate_state(
+            self.dispatch.init_state(), self.n_replicas
+        )
 
     def prepare(self, wr_opc, wr_args, rd_opc, rd_args):
-        # Reshape [S, R, Bw] → [S, L, B] buckets and re-key each bucket
-        # onto its congruence class so the LogMapper invariant holds.
         S = wr_opc.shape[0]
+        L = self.nlogs
         A = wr_args.shape[-1]
-        if self.B == 0:  # read-only sweep: no write buckets
+        N = int(np.prod(wr_opc.shape[1:]))  # client writes per step
+        if N == 0:  # read-only sweep: no write buckets
+            self._build(0)
             self._w = (
-                jnp.zeros((S, self.nlogs, 0), jnp.int32),
-                jnp.zeros((S, self.nlogs, 0, A), jnp.int32),
+                jnp.zeros((S, L, 0), jnp.int32),
+                jnp.zeros((S, L, 0, A), jnp.int32),
             )
-            self._counts = jnp.zeros((self.nlogs,), jnp.int64)
+            self._counts = jnp.zeros((S, L), jnp.int64)
             self._r = (jax.device_put(rd_opc), jax.device_put(rd_args))
+            self.dispatches_per_step = self.n_replicas * self.Br
+            self.client_ops_per_step = self.n_replicas * self.Br
             return
-        flat_opc = np.asarray(wr_opc).reshape(S, -1)
-        flat_args = np.asarray(wr_args).reshape(S, -1, wr_args.shape[-1])
-        need = self.nlogs * self.B
-        if flat_opc.shape[1] < need:
-            reps = -(-need // flat_opc.shape[1])
+        if wr_opc.shape[1:] != (self.n_replicas, self.Bw):
+            raise ValueError(
+                f"write stream is shaped {wr_opc.shape[1:]}, but this "
+                f"runner was declared (R={self.n_replicas}, "
+                f"Bw={self.Bw}) writes per step"
+            )
+        flat_opc = np.ascontiguousarray(np.asarray(wr_opc).reshape(S, N))
+        flat_args = np.ascontiguousarray(
+            np.asarray(wr_args).reshape(S, N, A)
+        )
+        if self.rebalance:
+            opc_b, args_b, counts = self._rebalanced(flat_opc, flat_args)
+        else:
+            opc_b, args_b, counts = self._hash_routed(flat_opc, flat_args)
+        self._build(opc_b.shape[2])
+        self._w = (jnp.asarray(opc_b), jnp.asarray(args_b))
+        self._counts = jnp.asarray(counts, jnp.int64)
+        self._r = (jax.device_put(rd_opc), jax.device_put(rd_args))
+        # Appended entries per step from the ACTUAL routed counts (they
+        # sum to N for hash routing, and to L*ceil(N/L) for the tiled
+        # rebalance) — each is one client write, replayed by every
+        # replica; padding slots beyond counts never append.
+        appended = int(counts[0].sum())
+        self.dispatches_per_step = (
+            self.n_replicas * appended + self.n_replicas * self.Br
+        )
+        self.client_ops_per_step = appended + self.n_replicas * self.Br
+
+    def _hash_routed(self, flat_opc, flat_args):
+        """Stable-bucket the stream by `key % L` (the LogMapper hash),
+        preserving per-log stream order; pad to the worst bucket."""
+        S, N = flat_opc.shape
+        L = self.nlogs
+        logidx = flat_args[..., 0].astype(np.int64) % L
+        counts = np.zeros((S, L), np.int64)
+        for s in range(S):
+            counts[s] = np.bincount(logidx[s], minlength=L)
+        B = int(counts.max())
+        opc_b = np.zeros((S, L, B), np.int32)  # NOOP padding
+        args_b = np.zeros((S, L, B, flat_args.shape[-1]), np.int32)
+        # padded slots keep the congruence invariant (key ≡ log mod L)
+        args_b[..., 0] = np.arange(L, dtype=np.int32)[None, :, None]
+        for s in range(S):
+            order = np.argsort(logidx[s] * N + np.arange(N))
+            slog = logidx[s][order]
+            pos = np.arange(N) - np.searchsorted(slog, slog)
+            opc_b[s, slog, pos] = flat_opc[s][order]
+            args_b[s, slog, pos] = flat_args[s][order]
+        return opc_b, args_b, counts
+
+    def _rebalanced(self, flat_opc, flat_args):
+        """r2-style balanced congruence re-key (opt-in): equal per-log
+        buckets; keys rewritten into the bucket's congruence class within
+        the keyspace truncated to a multiple of L."""
+        S, N = flat_opc.shape
+        L = self.nlogs
+        B = -(-N // L)
+        need = L * B
+        if N < need:
+            reps = -(-need // N)
             flat_opc = np.tile(flat_opc, (1, reps))
             flat_args = np.tile(flat_args, (1, reps, 1))
-        flat_opc = flat_opc[:, :need].reshape(S, self.nlogs, self.B)
-        flat_args = flat_args[:, :need].reshape(
-            S, self.nlogs, self.B, -1
-        ).copy()
-        # Re-key within the keyspace truncated to a multiple of L so the
-        # transform both preserves congruence classes AND never produces a
-        # key >= keyspace (which would alias dense cells `k % n_keys`).
+        opc_b = flat_opc[:, :need].reshape(S, L, B)
+        args_b = flat_args[:, :need].reshape(S, L, B, -1).copy()
         base = (
             self.keyspace
             if self.keyspace is not None
-            else int(flat_args[..., 0].max()) + 1
+            else int(args_b[..., 0].max()) + 1
         )
-        if base < self.nlogs:
+        if base < L:
             raise ValueError(
-                f"keyspace {base} < nlogs {self.nlogs}: the congruence "
-                f"re-key cannot give every log a distinct key class"
+                f"keyspace {base} < nlogs {L}: the congruence re-key "
+                f"cannot give every log a distinct key class"
             )
-        k_eff = (base // self.nlogs) * self.nlogs
-        lanes = np.arange(self.nlogs, dtype=np.int32)[None, :, None]
-        flat_args[..., 0] = (
-            (flat_args[..., 0] % k_eff) // self.nlogs
-        ) * self.nlogs + lanes
-        self._w = (jnp.asarray(flat_opc), jnp.asarray(flat_args))
-        self._counts = jnp.full((self.nlogs,), self.B, jnp.int64)
-        self._r = (jax.device_put(rd_opc), jax.device_put(rd_args))
+        k_eff = (base // L) * L
+        lanes = np.arange(L, dtype=np.int32)[None, :, None]
+        args_b[..., 0] = (
+            (args_b[..., 0] % k_eff) // L
+        ) * L + lanes
+        counts = np.full((S, L), B, np.int64)
+        return opc_b, args_b, counts
 
     def run_step(self, s: int):
         self.ml, self.states, _, self._last = self.step(
             self.ml, self.states, self._w[0][s], self._w[1][s],
-            self._counts, self._r[0][s], self._r[1][s],
+            self._counts[s], self._r[0][s], self._r[1][s],
         )
 
     def block(self):
         fence(self.ml, self.states)
+
+    def stats(self) -> dict:
+        """Per-log progress — the observable where zipf imbalance shows:
+        a hot key's log runs ahead of the others in appended depth."""
+        tails = [int(x) for x in np.asarray(self.ml.tail)]
+        total = sum(tails)
+        mean = total / max(len(tails), 1)
+        return {
+            "per_log_tail": tails,
+            "appended_total": total,
+            "imbalance": (max(tails) / mean) if mean else 1.0,
+        }
 
     def state_dump(self, rid: int = 0):
         return jax.tree.map(lambda a: np.asarray(a[rid]), self.states)

@@ -19,6 +19,19 @@ scan-replay inner loops if XLA fusion falls short"):
 All replicas replay the same window at the same offsets (the lock-step
 precondition of the fused step), so one kernel grid covers the fleet.
 
+Hardware-proven (round 3, TPU v5e, fenced D2H measurement): at
+R=4096/K=1024 the Mosaic lowering compiles and runs, and `bench.py
+--pallas` measures 1.22G dispatches/s vs 13.0M for the generic vmapped
+scan at the identical config — a ~94x win over per-entry XLA replay, the
+comparison this kernel exists for (`nr/src/log.rs:473-524` is the
+reference's hot loop). The *combined* window replay
+(`Dispatch.window_apply`, `models/hashmap.py`) measures 1.75G at the same
+config by replacing sequential replay with a parallel reduction — an
+algorithmic change, available only to models with last-writer-wins write
+semantics; this kernel remains the fast path for per-entry sequential
+replay (and the template for models that need it). Non-interpret smoke:
+`NR_TPU_SMOKE=1 pytest tests/test_pallas.py::TestHardwareSmoke`.
+
 Opcodes follow `models/hashmap.py`: PUT=1 (k, v → 0), REMOVE=2 (k → was
 present). `present` is int32 here (lane-friendly); `make_pallas_step`
 exposes the same step contract as `core/step.make_step` over the

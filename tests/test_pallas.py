@@ -5,6 +5,8 @@ Differential contract: the kernel must agree with the generic scan path
 streams.
 """
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -124,3 +126,46 @@ class TestNegativeKeys:
         assert np.all(v[15, :] == 111)
         assert np.all(v[0, :] == 222)
         assert np.all(v[3, :] == 333)
+
+
+class TestHardwareSmoke:
+    @pytest.mark.skipif(
+        os.environ.get("NR_TPU_SMOKE") != "1",
+        reason="set NR_TPU_SMOKE=1 to run the non-interpret Mosaic "
+               "lowering on real TPU hardware (needs the chip; the suite "
+               "itself runs on forced-CPU). Proven r3 on TPU v5e: "
+               "bench.py --pallas --keys 1024 = 1.22G dispatches/s vs "
+               "13.0M for the generic scan at the same config.",
+    )
+    def test_kernel_compiles_and_runs_on_tpu(self):
+        # subprocess: the suite's conftest forces jax_platforms=cpu, so
+        # the hardware probe needs a fresh interpreter on the default
+        # (TPU) platform
+        import subprocess
+        import sys
+
+        code = """
+import numpy as np, jax, jax.numpy as jnp
+assert jax.devices()[0].platform != "cpu", jax.devices()
+from node_replication_tpu.ops.pallas_replay import make_hashmap_replay
+K, R, W = 64, 256, 32
+replay = make_hashmap_replay(K, R, W, interpret=False)
+opc = jnp.asarray([1, 2] * (W // 2), jnp.int32)
+keys = jnp.arange(W, dtype=jnp.int32) % K
+vals = 100 + jnp.arange(W, dtype=jnp.int32)
+values = jnp.zeros((K, R), jnp.int32)
+present = jnp.zeros((K, R), jnp.int32)
+values, present, resps = replay(opc, keys, vals, values, present)
+v = np.asarray(values)
+# even entries PUT key i val 100+i; odd entries REMOVE key i
+assert np.all(v[0, :] == 100)
+assert np.all(np.asarray(present)[1, :] == 0)
+print("pallas-on-tpu OK", jax.devices()[0].device_kind)
+"""
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "pallas-on-tpu OK" in out.stdout

@@ -228,7 +228,7 @@ class TestRunnerRekey:
         # dense cells across logs.
         K, nlogs = 30, 4  # keyspace NOT a multiple of nlogs
         pm = None
-        r = MultiLogRunner(make_hashmap(K), 2, nlogs, 4, 2,
+        r = MultiLogRunner(make_hashmap(K), 2, nlogs, 8, 2,
                            partitioned=pm, keyspace=K)
         rng = np.random.default_rng(0)
         S = 3
@@ -243,12 +243,50 @@ class TestRunnerRekey:
         for l in range(nlogs):
             assert (keys[:, l, :] % nlogs == l).all()
 
+    def test_rebalance_rekey_stays_in_keyspace_and_congruent(self):
+        # the opt-in balanced re-key (rebalance=True) rewrites keys into
+        # congruence classes; ADVICE r1: rewritten keys must stay inside
+        # the keyspace even when K % L != 0
+        K, nlogs = 30, 4
+        r = MultiLogRunner(make_hashmap(K), 2, nlogs, 8, 2,
+                           keyspace=K, rebalance=True)
+        rng = np.random.default_rng(0)
+        S = 3
+        wr_opc = np.full((S, 2, 8), HM_PUT, np.int32)
+        wr_args = np.zeros((S, 2, 8, 3), np.int32)
+        wr_args[..., 0] = rng.integers(0, K, (S, 2, 8))
+        rd_opc = np.full((S, 2, 2), HM_GET, np.int32)
+        rd_args = np.zeros((S, 2, 2, 3), np.int32)
+        r.prepare(wr_opc, wr_args, rd_opc, rd_args)
+        keys = np.asarray(r._w[1])[..., 0]
+        assert keys.max() < K
+        for l in range(nlogs):
+            assert (keys[:, l, :] % nlogs == l).all()
+        # buckets are exactly equal — the whole point of the opt-in
+        counts = np.asarray(r._counts)
+        assert (counts == counts[0, 0]).all()
+        # accounting follows the ACTUAL appended (tiled) count, which may
+        # exceed the client stream size N=16: L * ceil(N/L)
+        appended = int(counts[0].sum())
+        assert appended == nlogs * -(-16 // nlogs)
+        assert r.client_ops_per_step == appended + 2 * 2
+
+    def test_rebalance_rejects_keyspace_smaller_than_nlogs(self):
+        r = MultiLogRunner(make_hashmap(2), 1, 4, 4, 0, keyspace=2,
+                           rebalance=True)
+        wr_opc = np.full((1, 1, 4), HM_PUT, np.int32)
+        wr_args = np.zeros((1, 1, 4, 3), np.int32)
+        with pytest.raises(ValueError, match="keyspace"):
+            r.prepare(wr_opc, wr_args,
+                      np.zeros((1, 1, 0), np.int32),
+                      np.zeros((1, 1, 0, 3), np.int32))
+
     def test_partitioned_runner_matches_fold_runner(self):
         K, nlogs, R = 32, 4, 2
         pm = make_partitioned_hashmap(K, nlogs)
-        r_fold = MultiLogRunner(make_hashmap(K), R, nlogs, 4, 2,
+        r_fold = MultiLogRunner(make_hashmap(K), R, nlogs, 8, 2,
                                 keyspace=K)
-        r_part = MultiLogRunner(make_hashmap(K), R, nlogs, 4, 2,
+        r_part = MultiLogRunner(make_hashmap(K), R, nlogs, 8, 2,
                                 partitioned=pm, keyspace=K)
         rng = np.random.default_rng(1)
         S = 4
