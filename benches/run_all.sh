@@ -15,13 +15,19 @@ python hashmap.py --baseline --duration "$DUR" --out-dir "$OUT" $EXTRA
 python stack.py --replicas 4 16 --duration "$DUR" $EXTRA
 python synthetic.py --replicas 4 --duration "$DUR" --out-dir "$OUT" $EXTRA
 python vspace.py --replicas 4 --duration "$DUR" $EXTRA
+python vspace.py --long-log --replicas 4 --duration "$DUR" $EXTRA
 python memfs.py --replicas 4 --duration "$DUR" $EXTRA
 python nrfs.py --replicas 4 --logs 1 4 --duration "$DUR" $EXTRA
 python lockfree.py --replicas 4 --logs 1 4 --duration "$DUR" \
   --out-dir "$OUT" $EXTRA
 python log.py --duration "$DUR" $EXTRA
-python hashbench.py -r 2 -w 1 --replicas 2 --duration "$DUR" $EXTRA
+python hashbench.py -r 2 -w 1 --replicas 2 --duration "$DUR" \
+  --out-dir "$OUT" $EXTRA
+python hashbench.py -r 2 -w 1 --replicas 2 --duration "$DUR" \
+  --ffi-smoke $EXTRA
 python chashbench.py -r 2 -w 2 --replicas 2 --duration "$DUR" $EXTRA
+python hashmap.py --sparse --keys 4096 --replicas 8 --duration "$DUR" \
+  $EXTRA
 python rwlockbench.py -r 1 4 -w 0 1 --duration "$DUR" $EXTRA
 XLA_FLAGS=--xla_force_host_platform_device_count=8 python ringreplay.py \
   --cpu --devices 8 --window 512 --replicas 8 --duration "$DUR"

@@ -2,15 +2,17 @@
 """VSpace bench: page-table map/unmap replay (`benches/vspace.rs`).
 
 The NrOS use-case: a virtual address space replayed through the log. The
-workload maps multi-page spans (VS_MAP) with occasional unmaps, reading
-back translations (VS_IDENTIFY) — a long-log replay with wide scatters per
-entry.
+default model is the 4-level radix (`make_vspace_radix`): Map / MapDevice
+/ Unmap / table-teardown ops over real PML4/PDPT/PD present tables
+(`benches/vspace.rs:176-481`); `--flat` selects the last-level-only
+variant. `--long-log` is the BASELINE.md long-log replay config: a big
+VA window, wide spans, large batches — deep replay windows per step.
 """
 
 from common import base_parser, finish_args
 
-from node_replication_tpu.harness import ScaleBenchBuilder, WorkloadSpec
-from node_replication_tpu.models import make_vspace
+from node_replication_tpu.harness import WorkloadSpec
+from node_replication_tpu.models import make_vspace, make_vspace_radix
 
 
 def main():
@@ -18,32 +20,52 @@ def main():
     p.add_argument("--pages", type=int, default=None)
     p.add_argument("--span", type=int, default=8,
                    help="max pages per map op (fixed scatter width)")
+    p.add_argument("--flat", action="store_true",
+                   help="flat last-level model instead of the 4-level "
+                        "radix")
+    p.add_argument("--long-log", action="store_true",
+                   help="BASELINE.md long-log replay config: "
+                        "pages=2^18, span=64, batch=1024")
     args = finish_args(p.parse_args())
-    pages = args.pages or (1 << 24 if args.full else 1 << 18)
+    if args.long_log:
+        pages = args.pages or (1 << 18)
+        args.span = 64
+        args.batch = [1024]
+    else:
+        pages = args.pages or (1 << 24 if args.full else 1 << 18)
 
     from node_replication_tpu.harness.mkbench import measure_step_runner
     from node_replication_tpu.harness.trait import ReplicatedRunner
     from node_replication_tpu.harness.workloads import generate_batches
 
+    # write mix: maps dominate, with device maps, unmaps, and (radix)
+    # table teardowns; npages rides args[1] and clips to --span
+    wr_mix = (1, 1, 1, 2) if args.flat else (1, 1, 1, 2, 3, 4)
+    model = (
+        (lambda: make_vspace(pages, max_span=args.span))
+        if args.flat
+        else (lambda: make_vspace_radix(pages, max_span=args.span))
+    )
+    name = "vspace-flat" if args.flat else "vspace-radix"
     for R in args.replicas:
         for batch in args.batch:
             spec = WorkloadSpec(keyspace=pages, write_ratio=75,
                                 seed=args.seed)
             wr_opc, wr_args, rd_opc, rd_args = generate_batches(
-                spec, 16, R, batch, 1, wr_opcode=(1, 1, 1, 2), rd_opcode=1
+                spec, 16, R, batch, 1, wr_opcode=wr_mix, rd_opcode=1
             )
             # arg lanes: (vpage, pframe, npages) — give every op a real
             # span so maps/unmaps touch 1..span pages
             wr_args[..., 2] = 1 + (wr_args[..., 1] % args.span)
-            runner = ReplicatedRunner(
-                make_vspace(pages, max_span=args.span), R, batch, 1
-            )
+            runner = ReplicatedRunner(model(), R, batch, 1)
             res = measure_step_runner(
                 runner, wr_opc, wr_args, rd_opc, rd_args,
                 duration_s=args.duration,
             )
-            print(f">> vspace/nr R={R} batch={batch}: {res.mops:.2f} Mops"
-                  f" (pages touched ≤{args.span}/op)")
+            print(f">> {name}/nr R={R} batch={batch}: "
+                  f"{res.client_mops:.2f} Mops client "
+                  f"({res.mops:.2f} Mops replayed, pages touched "
+                  f"<={args.span}/op)")
 
 
 if __name__ == "__main__":

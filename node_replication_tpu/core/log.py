@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from node_replication_tpu.ops.encoding import Dispatch, NOOP, apply_write
+from node_replication_tpu.utils.checks import check
 
 PyTree = Any
 
@@ -137,6 +138,17 @@ def log_append(
     """
     batch = opcodes.shape[0]
     count = jnp.asarray(count, jnp.int64)
+    # Debug invariant (the panic the reference compiles in at
+    # `nr/src/log.rs:487-489`'s append-side dual): an append that runs
+    # past `head + capacity` overwrites entries some replica has not yet
+    # replayed — silent data loss in release, an error under
+    # NR_TPU_DEBUG (utils/checks.py).
+    check(
+        log.tail + count <= log.head + spec.capacity,
+        "log_append overwrites unconsumed entries: tail {t} + count {c} "
+        "> head {h} + capacity " + str(spec.capacity),
+        t=log.tail, c=count, h=log.head,
+    )
     lanes = jnp.arange(batch, dtype=jnp.int64)
     valid = lanes < count
     # Invalid lanes scatter to index L, which mode="drop" discards: the
@@ -149,6 +161,18 @@ def log_append(
         args=log.args.at[slot].set(args, mode="drop"),
         tail=log.tail + count,
     )
+
+
+def gather_window(spec, opcodes_ring, args_ring, start, tail, window: int):
+    """Gather `window` ring entries from logical position `start`, masking
+    positions at or past `tail` to NOOP (positional liveness — the shared
+    read side of every combined-replay engine; keep the masking rule in
+    ONE place so the engines cannot desynchronize)."""
+    lanes = jnp.arange(window, dtype=jnp.int64)
+    pos = start + lanes
+    idx = (pos & spec.mask).astype(jnp.int32)
+    opcodes = jnp.where(pos < tail, opcodes_ring[idx], NOOP)
+    return opcodes, args_ring[idx]
 
 
 def _exec_one(
@@ -174,6 +198,16 @@ def _exec_one(
     `advance_head` (`nr/src/log.rs:536-539`).
     """
     eff_tail = log.tail if limit is None else jnp.minimum(log.tail, limit)
+    # Debug invariants (`nr/src/log.rs:487-489` panics on a local tail
+    # past the global tail; replaying below `head` reads slots GC may
+    # have handed to appenders — both silently clamp in release):
+    check(ltail <= log.tail,
+          "replica ltail {lt} ahead of log tail {t}",
+          lt=ltail, t=log.tail)
+    check(ltail >= log.head,
+          "replay window starts at {lt}, behind GC head {h}: entries "
+          "already overwritten",
+          lt=ltail, h=log.head)
 
     def body(state, j):
         pos = ltail + j

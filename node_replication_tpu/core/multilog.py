@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from node_replication_tpu.core.log import LogSpec
+from node_replication_tpu.core.log import LogSpec, gather_window
 from node_replication_tpu.ops.encoding import (
     Dispatch,
     NOOP,
@@ -162,6 +162,21 @@ def _exec_one_log(spec, d, opcodes_ring, args_ring, tail, state, ltail,
     return state, resps, jnp.minimum(ltail + window, tail)
 
 
+def _exec_one_log_combined(spec, d, opcodes_ring, args_ring, tail, state,
+                           ltail, window: int):
+    """Combined twin of `_exec_one_log`: gather the pending window from
+    the ring (positions past `tail` mask to NOOP — inactive under
+    `window_apply`) and apply it as one reduction (`Dispatch.window_apply`
+    semantics; bit-identical to the scan)."""
+    if window == 0:
+        return state, jnp.zeros((0,), jnp.int32), ltail
+    opcodes, args = gather_window(
+        spec, opcodes_ring, args_ring, ltail, tail, window
+    )
+    state, resps = d.window_apply(state, opcodes, args)
+    return state, resps, jnp.minimum(ltail + window, tail)
+
+
 def multilog_exec_all(
     spec: MultiLogSpec,
     d: Dispatch,
@@ -169,6 +184,8 @@ def multilog_exec_all(
     states: PyTree,
     window: int,
     partitioned: "PartitionedModel | None" = None,
+    combined: bool | None = None,
+    lockstep: bool = False,
 ):
     """Replay `window` pending entries of every log into every replica.
 
@@ -179,6 +196,19 @@ def multilog_exec_all(
     fold sequentially per replica (still correct for any state; ops on
     different logs commute by the LogMapper contract so order is free).
 
+    `combined` selects the per-(log, replica) replay engine when the
+    partitioned sub-model provides `window_apply` (None = auto): each
+    log's window collapses to one parallel reduction on its partition
+    instead of a `window`-long scan — the multi-log form of the combined
+    replay (`core/step.py`).
+
+    `lockstep=True` asserts the caller's precondition that every replica
+    of a log starts at the same ltail (true inside `make_multilog_step`):
+    the combined path then gathers each log's window ONCE and shares its
+    sort across the replica vmap — without it the window (and its sort)
+    is recomputed per (log, replica) because ltails are formally
+    per-replica values.
+
     Returns `(ml, states, resps[L, R, window])`.
     """
     if partitioned is not None:
@@ -187,16 +217,46 @@ def multilog_exec_all(
                 f"PartitionedModel is {partitioned.nlogs}-way but the "
                 f"multilog has {spec.nlogs} logs"
             )
+        if combined is None:
+            combined = partitioned.sub.window_apply is not None
+        if combined and partitioned.sub.window_apply is None:
+            raise ValueError(
+                f"combined=True but {partitioned.sub.name} has no "
+                f"window_apply"
+            )
+        exec_one = _exec_one_log_combined if combined else _exec_one_log
         # [R, ...] states → per-replica split → [R, L, sub...] → [L, R, ...]
         stacked = jax.vmap(partitioned.split)(states)
         stacked = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), stacked)
 
-        def per_log(opc, arg, tail, sub_states, ltails):
-            return jax.vmap(
-                lambda s, lt: _exec_one_log(
-                    spec, partitioned.sub, opc, arg, tail, s, lt, window
+        if combined and lockstep and window > 0:
+            # lock-step: gather each log's window once (ltails[0] speaks
+            # for the fleet) so the window-wide sort inside window_apply
+            # stays UNBATCHED across the replica vmap
+            def per_log(opc, arg, tail, sub_states, ltails):
+                lt0 = ltails[0]
+                opc_w, args_w = gather_window(
+                    spec, opc, arg, lt0, tail, window
                 )
-            )(sub_states, ltails)
+                new_states, resps = jax.vmap(
+                    lambda s: partitioned.sub.window_apply(
+                        s, opc_w, args_w
+                    )
+                )(sub_states)
+                new_lt = jnp.minimum(lt0 + window, tail)
+                return (
+                    new_states,
+                    resps,
+                    jnp.broadcast_to(new_lt, ltails.shape),
+                )
+        else:
+            def per_log(opc, arg, tail, sub_states, ltails):
+                return jax.vmap(
+                    lambda s, lt: exec_one(
+                        spec, partitioned.sub, opc, arg, tail, s, lt,
+                        window,
+                    )
+                )(sub_states, ltails)
 
         new_subs, resps, new_ltails = jax.vmap(per_log)(
             ml.opcodes, ml.args, ml.tail, stacked, ml.ltails
@@ -241,6 +301,7 @@ def make_multilog_step(
     partitioned: "PartitionedModel | None" = None,
     jit: bool = True,
     donate: bool = True,
+    combined: bool | None = None,
 ):
     """Fused CNR step: per-log append → per-log replay → reads.
 
@@ -266,7 +327,8 @@ def make_multilog_step(
     def step(ml, states, wr_opcodes, wr_args, counts, rd_opcodes, rd_args):
         ml = multilog_append(spec, ml, wr_opcodes, wr_args, counts)
         ml, states, wr_resps = multilog_exec_all(
-            spec, dispatch, ml, states, B, partitioned=partitioned
+            spec, dispatch, ml, states, B, partitioned=partitioned,
+            combined=combined, lockstep=True,
         )
         rd_resps = dispatch_reads(dispatch, states, rd_opcodes, rd_args)
         return ml, states, wr_resps, rd_resps

@@ -123,6 +123,7 @@ class NodeReplicated:
         gc_slack: int | None = None,
         exec_window: int = DEFAULT_EXEC_WINDOW,
         gc_callback: Callable[[int, int], None] | None = None,
+        debug: bool | None = None,
     ):
         kw = {}
         if log_entries is not None:
@@ -135,6 +136,16 @@ class NodeReplicated:
         self.dispatch = dispatch
         self.exec_window = int(exec_window)
         self.gc_callback = gc_callback
+        # `debug` compiles device-side cursor invariants into the append
+        # and replay programs (checkify — utils/checks.py): invalid
+        # ltails and window-overrunning appends raise instead of
+        # clamping. Off (default) the compiled programs are unchanged;
+        # None defers to the NR_TPU_DEBUG env var.
+        if debug is None:
+            from node_replication_tpu.utils.checks import debug_default
+
+            debug = debug_default()
+        self.debug = bool(debug)
 
         self.log = log_init(self.spec)
         self.states = replicate_state(dispatch.init_state(), n_replicas)
@@ -145,14 +156,25 @@ class NodeReplicated:
         self._inflight: list[deque] = [deque() for _ in range(n_replicas)]
         self._exec_rounds = 0
 
-        self._exec_jit = jax.jit(
-            partial(log_exec_all, self.spec, dispatch),
-            static_argnames=("window",),
-            donate_argnums=(0, 1),
-        )
-        self._append_jit = jax.jit(
-            partial(log_append, self.spec), donate_argnums=(0,)
-        )
+        if self.debug:
+            from node_replication_tpu.utils.checks import checked
+
+            self._exec_jit = jax.jit(
+                checked(partial(log_exec_all, self.spec, dispatch)),
+                static_argnames=("window",),
+            )
+            self._append_jit = jax.jit(
+                checked(partial(log_append, self.spec))
+            )
+        else:
+            self._exec_jit = jax.jit(
+                partial(log_exec_all, self.spec, dispatch),
+                static_argnames=("window",),
+                donate_argnums=(0, 1),
+            )
+            self._append_jit = jax.jit(
+                partial(log_append, self.spec), donate_argnums=(0,)
+            )
 
         def _read_one(states, rid, opcode, args):
             state = jax.tree.map(lambda a: a[rid], states)
@@ -271,7 +293,7 @@ class NodeReplicated:
             [(o, *a) for _, o, a in ops], self.spec.arg_width, pad_to=pad
         )
         with span("append", rid=rid, n=n, pos0=pos0):
-            self.log = self._append_jit(self.log, opcodes, args, n)
+            self.log = self._append_call(opcodes, args, n)
         inflight = self._inflight[rid]
         for j, (tid, _, _) in enumerate(ops):
             inflight.append((pos0 + j, tid))
@@ -368,14 +390,33 @@ class NodeReplicated:
 
     # ------------------------------------------------------------ internals
 
+    def _append_call(self, opcodes, args, n):
+        if self.debug:
+            from node_replication_tpu.utils.checks import debug_checks
+
+            with debug_checks(True):  # checks live at (re-)trace time
+                err, log = self._append_jit(self.log, opcodes, args, n)
+            err.throw()
+            return log
+        return self._append_jit(self.log, opcodes, args, n)
+
     def _exec_round(self) -> bool:
         """One static-window replay round for every replica, plus response
         distribution. Returns True if any replica made progress."""
         ltails_before = np.asarray(self.log.ltails).copy()
         self._exec_rounds += 1
-        self.log, self.states, resps = self._exec_jit(
-            self.log, self.states, window=self.exec_window
-        )
+        if self.debug:
+            from node_replication_tpu.utils.checks import debug_checks
+
+            with debug_checks(True):  # checks live at (re-)trace time
+                err, (self.log, self.states, resps) = self._exec_jit(
+                    self.log, self.states, window=self.exec_window
+                )
+            err.throw()
+        else:
+            self.log, self.states, resps = self._exec_jit(
+                self.log, self.states, window=self.exec_window
+            )
         ltails_after = np.asarray(self.log.ltails)
         resps_np = np.asarray(resps)
         for r in range(self.n_replicas):

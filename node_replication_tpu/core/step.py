@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from node_replication_tpu.core.log import (
     LogSpec,
+    gather_window,
     log_append,
     log_exec_all,
 )
@@ -94,14 +95,17 @@ def make_step(
             span,
         )
         # 3. replay exactly the appended window into every replica.
-        if combined:
+        if combined and span == 0:
+            # read-only step: nothing appended, nothing to replay
+            resps = jnp.zeros((R, 0), jnp.int32)
+        elif combined:
             # combined replay: gather the appended window from the ring
             # and apply it as one reduction per replica (vmap keeps the
             # window-wide sort unbatched — it is shared by the fleet)
-            lanes = jnp.arange(span, dtype=jnp.int64)
-            idx = ((log.tail - span + lanes) & spec.mask).astype(jnp.int32)
-            opc_w = log.opcodes[idx]
-            args_w = log.args[idx]
+            opc_w, args_w = gather_window(
+                spec, log.opcodes, log.args, log.tail - span, log.tail,
+                span,
+            )
             states, resps = jax.vmap(
                 lambda s: dispatch.window_apply(s, opc_w, args_w)
             )(states)

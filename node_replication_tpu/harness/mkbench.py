@@ -232,6 +232,7 @@ class ScaleBenchBuilder:
         self._out_dir = "."
         self._partitioned_factory: Callable | None = None
         self._strategies: list = [None]
+        self._replay: str = "auto"
 
     def replicas(self, counts: Sequence[int]):
         self._replicas = list(counts)
@@ -275,11 +276,25 @@ class ScaleBenchBuilder:
         self._out_dir = path
         return self
 
+    def replay(self, mode: str):
+        """Replay engine for nr/cnr runners: 'auto' (combined when the
+        model provides `window_apply`), 'scan' (force the per-entry
+        vmapped scan — the faithful analog of the reference's replay
+        loop), 'combined' (require `window_apply`)."""
+        if mode not in ("auto", "scan", "combined"):
+            raise ValueError(f"unknown replay mode {mode!r}")
+        self._replay = mode
+        return self
+
     def _make_runner(self, system: str, nlogs: int, R: int, bw: int,
                      br: int, strategy=None) -> FleetRunner | None:
         d = self.dispatch_factory()
+        combined = {"auto": None, "scan": False, "combined": True}[
+            self._replay
+        ]
         if system == "nr" and nlogs == 1:
-            return ReplicatedRunner(d, R, bw, br, self._log_capacity)
+            return ReplicatedRunner(d, R, bw, br, self._log_capacity,
+                                    combined=combined)
         if system == "cnr" and nlogs > 1:
             part = None
             if self._partitioned_factory is not None:
@@ -291,9 +306,16 @@ class ScaleBenchBuilder:
                     # aborting the whole sweep mid-run.
                     print(f"## cnr{nlogs}: partitioned replay unavailable "
                           f"({e}); using sequential fold")
+            if combined and part is None:
+                # never mislabel: a forced-combined config without a
+                # partitioned model would silently measure the scan fold
+                print(f"## cnr{nlogs}: skipping — replay 'combined' "
+                      f"forced but no partitioned model")
+                return None
             return MultiLogRunner(d, R, nlogs, bw, br, self._log_capacity,
                                   partitioned=part,
-                                  keyspace=self.workload.keyspace)
+                                  keyspace=self.workload.keyspace,
+                                  combined=combined)
         if system == "partitioned" and nlogs == 1:
             return PartitionedRunner(d, R, bw, br)
         if system == "concurrent" and nlogs == 1:
@@ -338,6 +360,9 @@ class ScaleBenchBuilder:
                         )
                         if runner is None:
                             continue
+                        if (self._replay != "auto"
+                                and system in ("nr", "cnr")):
+                            runner.name += f"-{self._replay}"
                         gen = generate_batches(
                             self.workload, self._steps, R, bw, br
                         )

@@ -89,7 +89,8 @@ class ReplicatedRunner(FleetRunner):
     def __init__(self, dispatch: Dispatch, n_replicas: int,
                  writes_per_replica: int, reads_per_replica: int,
                  log_capacity: int | None = None,
-                 track_resp: int | None = None):
+                 track_resp: int | None = None,
+                 combined: bool | None = None):
         self.name = "nr"
         self.dispatch = dispatch
         self.n_replicas = n_replicas
@@ -101,7 +102,8 @@ class ReplicatedRunner(FleetRunner):
             arg_width=dispatch.arg_width,
             gc_slack=min(8192, span),
         )
-        self.step = make_step(dispatch, self.spec, self.Bw, self.Br)
+        self.step = make_step(dispatch, self.spec, self.Bw, self.Br,
+                              combined=combined)
         self.log = log_init(self.spec)
         self.states = replicate_state(dispatch.init_state(), n_replicas)
         # Each appended entry is replayed by every replica + local reads.
@@ -174,7 +176,8 @@ class MultiLogRunner(FleetRunner):
                  writes_per_replica: int, reads_per_replica: int,
                  log_capacity: int | None = None,
                  partitioned=None, keyspace: int | None = None,
-                 rebalance: bool = False):
+                 rebalance: bool = False,
+                 combined: bool | None = None):
         self.name = f"cnr{nlogs}" + ("p" if partitioned is not None else "")
         self.dispatch = dispatch
         self.n_replicas = n_replicas
@@ -183,6 +186,7 @@ class MultiLogRunner(FleetRunner):
         self.rebalance = rebalance
         self.partitioned = partitioned
         self.log_capacity = log_capacity
+        self.combined = combined
         self.Bw, self.Br = writes_per_replica, reads_per_replica
         self.B = None  # per-log pad width; fixed by prepare() from data
         self.step = None
@@ -198,9 +202,17 @@ class MultiLogRunner(FleetRunner):
             arg_width=self.dispatch.arg_width,
             gc_slack=min(1024, max(B, 1)),
         )
+        if self.combined and self.partitioned is None:
+            raise ValueError(
+                "combined=True needs a PartitionedModel (per-log "
+                "window_apply runs on state partitions); the "
+                "partitioned=None fold path is scan-only"
+            )
         self.step = make_multilog_step(
             self.dispatch, self.spec, B, self.Br,
             partitioned=self.partitioned,
+            combined=self.combined if self.partitioned is not None
+            else None,
         )
         self.ml = multilog_init(self.spec)
         self.states = replicate_state(

@@ -92,7 +92,9 @@ def _congruence_split(nlogs: int):
 
 def _div_arg0(d: Dispatch, nlogs: int, name: str) -> Dispatch:
     """Wrap a Dispatch so args[0] is divided by L before each op: the
-    partition-local addressing `k → k // L` of the congruence partition."""
+    partition-local addressing `k → k // L` of the congruence partition.
+    The combined `window_apply` (when the model has one) gets the same
+    key transform on its whole window."""
 
     def wrap(f):
         def g(s, a):
@@ -100,11 +102,21 @@ def _div_arg0(d: Dispatch, nlogs: int, name: str) -> Dispatch:
 
         return g
 
+    wa = d.window_apply
+    if wa is not None:
+        def window_apply(state, opcodes, args):
+            return wa(state, opcodes, args.at[:, 0].set(
+                args[:, 0] // nlogs
+            ))
+    else:
+        window_apply = None
+
     return dataclasses.replace(
         d,
         name=name,
         write_ops=tuple(wrap(f) for f in d.write_ops),
         read_ops=tuple(wrap(f) for f in d.read_ops),
+        window_apply=window_apply,
     )
 
 

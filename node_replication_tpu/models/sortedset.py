@@ -67,10 +67,53 @@ def make_sortedset(n_keys: int) -> Dispatch:
         ks = jnp.arange(n_keys, dtype=jnp.int32)
         return jnp.sum((ks < args[0]) & state["present"]).astype(jnp.int32)
 
+    def window_apply(state, opcodes, args):
+        """Combined replay (see `Dispatch.window_apply` and the hashmap
+        twin, `models/hashmap.py`): insert/remove are last-writer-wins
+        per key, and every response is presence-just-before — the
+        same-key predecessor's effect, or the replica's initial presence
+        on first touch. One stable sort + predecessor lookup + dense
+        merge, bit-identical to the sequential fold
+        (tests/test_window.py)."""
+        W = opcodes.shape[0]
+        k = args[:, 0] % n_keys
+        is_ins = opcodes == SS_INSERT
+        is_rem = opcodes == SS_REMOVE
+        active = is_ins | is_rem
+        key_eff = jnp.where(active, k, n_keys).astype(jnp.int64)
+        idx = jnp.arange(W, dtype=jnp.int64)
+        order = jnp.argsort(key_eff * (W + 1) + idx)
+        sk = key_eff[order]
+        same_prev = jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), sk[1:] == sk[:-1]]
+        )
+        prev = jnp.concatenate([order[:1], order[:-1]])
+        init_present = state["present"].at[
+            sk.astype(jnp.int32)
+        ].get(mode="clip")
+        pres_before = jnp.where(same_prev, is_ins[prev], init_present)
+        # insert → 1 if newly inserted (= !present-before); remove → 1 if
+        # present-before; inactive slots answer 0
+        resp_sorted = jnp.where(
+            is_ins[order],
+            (~pres_before).astype(jnp.int32),
+            jnp.where(is_rem[order], pres_before.astype(jnp.int32), 0),
+        )
+        resps = jnp.zeros((W,), jnp.int32).at[order].set(resp_sorted)
+        last = (
+            jnp.full((n_keys + 1,), -1, jnp.int64)
+            .at[key_eff].max(idx)[:n_keys]
+        )
+        touched = last >= 0
+        li = jnp.clip(last, 0).astype(jnp.int32)
+        present = jnp.where(touched, is_ins[li], state["present"])
+        return {"present": present}, resps
+
     return Dispatch(
         name=f"sortedset{n_keys}",
         make_state=make_state,
         write_ops=(insert, remove),
         read_ops=(contains, range_count, rank),
         arg_width=3,
+        window_apply=window_apply,
     )

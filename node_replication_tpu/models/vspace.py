@@ -100,3 +100,188 @@ def make_vspace(n_pages: int, max_span: int = 16) -> Dispatch:
         read_ops=(identify, resolved),
         arg_width=3,
     )
+
+
+# --------------------------------------------------------------- radix
+# The 4-level variant (`benches/vspace.rs:176-481` models the full x86-64
+# PML4→PDPT→PD→PT walk). Radix indices: 9 bits per level over the bounded
+# window, so level l covers 512^l pages per entry.
+
+VSR_MAP = 1
+VSR_MAP_DEVICE = 2
+VSR_UNMAP = 3
+VSR_UNMAP_TABLE = 4
+
+VSR_IDENTIFY = 1
+VSR_RESOLVED = 2
+VSR_TABLES = 3
+
+# pt entry encoding: 0 = not present; else (pframe + 1) | device << 30
+_DEV_BIT = jnp.int32(1 << 30)
+_FRAME_MASK = jnp.int32((1 << 30) - 1)
+
+
+def make_vspace_radix(n_pages: int, max_span: int = 16) -> Dispatch:
+    """4-level page-table vspace with per-level present tables.
+
+    Semantics note (the r2 question "is flat-last-level complete?"): over
+    a BOUNDED VA window with on-demand intermediate tables, the pointer
+    radix of the reference (`benches/vspace.rs:176-481`) is an addressing
+    scheme for a 256 TiB sparse space — a fixed-shape device model does
+    not need pointers to cover the same op semantics. What the radix adds
+    *observably* is (a) table-granular operations and (b) table
+    allocation accounting. This model keeps the flat PT as the last level
+    and maintains real PML4/PDPT/PD present tables on every walk:
+
+    Write opcodes:
+      VSR_MAP=1          (vpage, pframe, npages) → maps vpage+i ↦
+                         pframe+i, allocating the walk's tables;
+                         resp = #pages newly mapped.
+      VSR_MAP_DEVICE=2   same, but entries carry the device attribute
+                         (uncacheable MMIO — the reference's MapDevice);
+                         resp = #pages newly mapped.
+      VSR_UNMAP=3        (vpage, npages) → clears PT entries (tables
+                         stay allocated, as on a real unmap);
+                         resp = #pages that were mapped.
+      VSR_UNMAP_TABLE=4  (vpage) → tears down the PD-level table covering
+                         vpage: its 512-page region unmaps at once and
+                         the table deallocates (the radix-only O(table)
+                         region operation); resp = #pages that were
+                         mapped in the region.
+    Read opcodes:
+      VSR_IDENTIFY=1     (vpage) → (pframe+1) | device<<30 after a FULL
+                         walk (every level present), or -1.
+      VSR_RESOLVED=2     (vpage, npages) → #fully-walked mapped pages.
+      VSR_TABLES=3       () → #allocated PD tables (the memory-accounting
+                         observable the radix exists for).
+    """
+    l2 = max(1, -(-n_pages // 512))
+    l3 = max(1, -(-n_pages // (512 ** 2)))
+    l4 = max(1, -(-n_pages // (512 ** 3)))
+
+    def make_state():
+        return {
+            "pt": jnp.zeros((n_pages,), jnp.int32),
+            "pd": jnp.zeros((l2,), jnp.bool_),
+            "pdpt": jnp.zeros((l3,), jnp.bool_),
+            "pml4": jnp.zeros((l4,), jnp.bool_),
+        }
+
+    def _span_idx(vpage, npages):
+        lanes = jnp.arange(max_span, dtype=jnp.int32)
+        n = jnp.clip(npages, 0, max_span)
+        idx = jnp.where(
+            (lanes < n) & (vpage + lanes < n_pages),
+            (vpage + lanes) % n_pages,
+            n_pages,
+        )
+        return idx, lanes
+
+    def _walk_present(state, pages):
+        """Full 4-level walk for page indices (n_pages → False)."""
+        safe = jnp.minimum(pages, n_pages - 1)
+        ok = pages < n_pages
+        return (
+            ok
+            & state["pml4"].at[safe >> 27].get(mode="clip")
+            & state["pdpt"].at[safe >> 18].get(mode="clip")
+            & state["pd"].at[safe >> 9].get(mode="clip")
+            & (state["pt"].at[safe].get(mode="fill", fill_value=0) != 0)
+        )
+
+    # level-entry scatter width: a max_span run crosses at most this many
+    # PD entries (and always at most 2 at the higher levels)
+    _pd_w = -(-max_span // 512) + 1
+
+    def _mark_levels(state, vpage, npages):
+        n = jnp.clip(npages, 0, max_span)
+        # an empty map (npages <= 0) must not allocate tables — the
+        # VSR_TABLES accounting would report phantom allocations
+        live = n > 0
+        last = jnp.maximum(vpage + n - 1, vpage)
+        pd_lanes = (vpage >> 9) + jnp.arange(_pd_w, dtype=jnp.int32)
+        pd_idx = jnp.where(
+            live & (pd_lanes <= (last >> 9)) & (pd_lanes < l2),
+            pd_lanes, l2,
+        )
+        hi = jnp.stack([vpage >> 18, last >> 18])
+        hi_idx = jnp.where(live & (hi < l3), hi, l3)
+        top = jnp.stack([vpage >> 27, last >> 27])
+        top_idx = jnp.where(live & (top < l4), top, l4)
+        return {
+            "pt": state["pt"],
+            "pd": state["pd"].at[pd_idx].set(True, mode="drop"),
+            "pdpt": state["pdpt"].at[hi_idx].set(True, mode="drop"),
+            "pml4": state["pml4"].at[top_idx].set(True, mode="drop"),
+        }
+
+    def _map_common(state, args, device):
+        vpage, pframe, npages = args[0], args[1], args[2]
+        vpage = vpage % n_pages
+        idx, lanes = _span_idx(vpage, npages)
+        newly = jnp.sum(
+            jnp.where(idx < n_pages, ~_walk_present(state, idx), False)
+        )
+        entry = ((pframe + lanes + 1) & _FRAME_MASK) | (
+            _DEV_BIT if device else 0
+        )
+        state = _mark_levels(state, vpage, npages)
+        state = dict(state, pt=state["pt"].at[idx].set(entry, mode="drop"))
+        return state, newly.astype(jnp.int32)
+
+    def map_(state, args):
+        return _map_common(state, args, device=False)
+
+    def map_device(state, args):
+        return _map_common(state, args, device=True)
+
+    def unmap(state, args):
+        vpage, npages = args[0] % n_pages, args[1]
+        idx, _ = _span_idx(vpage, npages)
+        was = jnp.sum(
+            jnp.where(idx < n_pages, _walk_present(state, idx), False)
+        )
+        return dict(
+            state, pt=state["pt"].at[idx].set(0, mode="drop")
+        ), was.astype(jnp.int32)
+
+    def unmap_table(state, args):
+        # tear down the PD table covering vpage: count mapped pages in
+        # its 512-page region, zero the region's PT slice, clear the
+        # PD entry (fixed-shape: one 512-lane masked scatter)
+        vpage = args[0] % n_pages
+        pd_i = vpage >> 9
+        base = pd_i << 9
+        lanes = base + jnp.arange(512, dtype=jnp.int32)
+        idx = jnp.where(lanes < n_pages, lanes, n_pages)
+        was = jnp.sum(
+            jnp.where(idx < n_pages, _walk_present(state, idx), False)
+        )
+        return dict(
+            state,
+            pt=state["pt"].at[idx].set(0, mode="drop"),
+            pd=state["pd"].at[pd_i].set(False),
+        ), was.astype(jnp.int32)
+
+    def identify(state, args):
+        v = args[0] % n_pages
+        ok = _walk_present(state, jnp.asarray(v))
+        return jnp.where(ok, state["pt"][v], jnp.int32(-1))
+
+    def resolved(state, args):
+        vpage, npages = args[0] % n_pages, args[1]
+        idx, _ = _span_idx(vpage, npages)
+        return jnp.sum(
+            jnp.where(idx < n_pages, _walk_present(state, idx), False)
+        ).astype(jnp.int32)
+
+    def tables(state, args):
+        return jnp.sum(state["pd"]).astype(jnp.int32)
+
+    return Dispatch(
+        name=f"vspace_radix{n_pages}",
+        make_state=make_state,
+        write_ops=(map_, map_device, unmap, unmap_table),
+        read_ops=(identify, resolved, tables),
+        arg_width=3,
+    )

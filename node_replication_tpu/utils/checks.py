@@ -1,0 +1,67 @@
+"""Device-side defensive invariants (checkify debug mode).
+
+The reference guards its cursor invariants with `panic!`s compiled into
+every build — exec with a local tail ahead of the global tail
+(`nr/src/log.rs:487-489`) and context batch-index bounds
+(`nr/src/context.rs:145-148`, `186-190`). Compiled XLA code cannot panic,
+so the device path historically clamped/dropped silently. This module is
+the opt-in equivalent: `jax.experimental.checkify` checks inserted at the
+same invariant points.
+
+Two flags with different blast radii:
+
+- `NR_TPU_DEBUG=1` (env) flips the DEFAULT of `NodeReplicated(debug=...)`
+  to True — the end-to-end debug mode. It deliberately does NOT make
+  `check()` fire globally: a live `checkify.check` inside a jit that was
+  never `checked()`-wrapped is a trace-time error, so arming checks
+  process-wide would crash every unwrapped jit in the library.
+- `debug_checks(True)` (context manager) arms `check()` for code traced
+  inside it — use it only around calls whose functions are `checked()`-
+  functionalized (as `NodeReplicated` does internally). With the flag
+  off, `check()` is a no-op at trace time and the compiled program is
+  bit-identical to the unchecked one (zero cost off).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from jax.experimental import checkify
+
+_ctx_enabled = False
+
+
+def debug_default() -> bool:
+    """Default for `NodeReplicated(debug=...)` (the NR_TPU_DEBUG env)."""
+    return os.environ.get("NR_TPU_DEBUG", "") == "1"
+
+
+def debug_checks_enabled() -> bool:
+    return _ctx_enabled
+
+
+@contextlib.contextmanager
+def debug_checks(on: bool = True):
+    """Arm `check()` for functions traced within (tracing happens at the
+    first CALL of a jitted function, not at `jax.jit`). Only wrap calls
+    to `checked()`-functionalized functions."""
+    global _ctx_enabled
+    old, _ctx_enabled = _ctx_enabled, on
+    try:
+        yield
+    finally:
+        _ctx_enabled = old
+
+
+def check(pred, msg: str, **fmt) -> None:
+    """Emit a checkify invariant when armed at trace time; no-op (and no
+    cost in the compiled program) otherwise."""
+    if _ctx_enabled:
+        checkify.check(pred, msg, **fmt)
+
+
+def checked(fn):
+    """Functionalize a fn containing `check()` calls:
+    `checked(fn)(*a) -> (err, out)`; surface with `err.throw()`."""
+    return checkify.checkify(fn, errors=checkify.user_checks)

@@ -14,8 +14,8 @@ echo "== examples =="
 for f in examples/*.py; do python "$f"; done
 
 echo "== flagship bench =="
-python bench.py --replicas 256 --keys 1024 --steps 10 --warmup 2 \
-  | tee "$OUT/bench.json"
+python bench.py --replicas 256 --keys 1024 --steps 8 --repeats 2 \
+  --min-time 0.3 | tee "$OUT/bench.json"
 
 echo "== bench suite =="
 DUR=${DUR:-1.0} FULL=${FULL:-} bash benches/run_all.sh

@@ -1,0 +1,159 @@
+"""Checkify debug mode: device-side cursor invariants (VERDICT r2 #7).
+
+The reference compiles `panic!`s into its cursor paths
+(`nr/src/log.rs:487-489`, `nr/src/context.rs:145-148`); compiled XLA
+clamps silently. Under the debug flag (utils/checks.py) the same
+invariants become checkify errors; with the flag off the traced programs
+are unchanged (zero cost — pinned by comparing jaxprs).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import checkify
+
+from node_replication_tpu import LogSpec, log_append, log_exec_all, log_init
+from node_replication_tpu.core.replica import NodeReplicated, replicate_state
+from node_replication_tpu.models import HM_GET, HM_PUT, make_hashmap, make_stack
+from node_replication_tpu.models.stack import ST_PUSH
+from node_replication_tpu.ops.encoding import encode_ops
+from node_replication_tpu.utils.checks import checked, debug_checks
+
+
+def small():
+    spec = LogSpec(capacity=16, n_replicas=2, arg_width=3, gc_slack=4)
+    d = make_stack(32)
+    return spec, d
+
+
+class TestInvariantChecks:
+    def test_invalid_ltail_raises_under_debug(self):
+        # ltail ahead of tail: the `nr/src/log.rs:487-489` panic analog
+        spec, d = small()
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 2)
+        opc, args, n = encode_ops([(ST_PUSH, 1), (ST_PUSH, 2)], 3)
+        log = log_append(spec, log, opc, args, n)
+        log = log._replace(ltails=log.ltails.at[0].set(5))  # tail is 2
+        with debug_checks(True):
+            f = jax.jit(checked(partial(log_exec_all, spec, d)),
+                        static_argnames=("window",))
+            err, _ = f(log, states, window=4)
+        with pytest.raises(checkify.JaxRuntimeError, match="ahead of"):
+            err.throw()
+
+    def test_replay_behind_gc_head_raises_under_debug(self):
+        spec, d = small()
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 2)
+        opc, args, n = encode_ops([(ST_PUSH, 7)], 3)
+        log = log_append(spec, log, opc, args, n)
+        # pretend GC advanced past a replica that never replayed
+        log = log._replace(head=jnp.asarray(1, jnp.int64))
+        with debug_checks(True):
+            f = jax.jit(checked(partial(log_exec_all, spec, d)),
+                        static_argnames=("window",))
+            err, _ = f(log, states, window=2)
+        with pytest.raises(checkify.JaxRuntimeError, match="GC head"):
+            err.throw()
+
+    def test_over_capacity_append_raises_under_debug(self):
+        spec, d = small()  # capacity 16
+        log = log_init(spec)
+        opc, args, n = encode_ops([(ST_PUSH, i) for i in range(12)], 3)
+        with debug_checks(True):
+            f = jax.jit(checked(partial(log_append, spec)))
+            err, log = f(log, opc, args, n)
+            err.throw()  # first 12 fit
+            # 12 more without any replay: tail+12 > head+16 → overwrite
+            err, _ = f(log, opc, args, n)
+        with pytest.raises(checkify.JaxRuntimeError, match="overwrites"):
+            err.throw()
+
+    def test_clean_run_has_no_error_under_debug(self):
+        spec, d = small()
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 2)
+        opc, args, n = encode_ops([(ST_PUSH, 3)], 3)
+        with debug_checks(True):
+            fa = jax.jit(checked(partial(log_append, spec)))
+            err, log = fa(log, opc, args, n)
+            err.throw()
+            fe = jax.jit(checked(partial(log_exec_all, spec, d)),
+                         static_argnames=("window",))
+            err, (log, states, _) = fe(log, states, window=2)
+            err.throw()
+        assert list(np.asarray(states["top"])) == [1, 1]
+
+    def test_flag_off_traces_no_checks(self):
+        # zero-cost-off contract: with the flag off the jaxpr contains no
+        # checkify effects and the plain (unwrapped) call just works
+        spec, d = small()
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 2)
+        jaxpr = jax.make_jaxpr(
+            partial(log_exec_all, spec, d, window=2)
+        )(log, states)
+        assert "check" not in str(jaxpr)
+        log2, states2, _ = log_exec_all(spec, d, log, states, 2)
+        assert int(log2.tail) == 0
+
+
+class TestNodeReplicatedDebug:
+    def test_debug_instance_runs_and_catches_corruption(self):
+        nr = NodeReplicated(make_hashmap(64), n_replicas=2,
+                            log_entries=64, gc_slack=8, debug=True)
+        t0 = nr.register(0)
+        t1 = nr.register(1)
+        assert nr.execute_mut((HM_PUT, 5, 50), t0) == 0
+        assert nr.execute((HM_GET, 5), t1) == 50
+        # corrupt a cursor: the next replay round must raise, not clamp
+        nr.log = nr.log._replace(
+            ltails=nr.log.ltails.at[1].set(int(nr.log.tail) + 9)
+        )
+        with pytest.raises(checkify.JaxRuntimeError):
+            nr.flush()  # combine → replay round → invariant fires
+
+    def test_env_var_flips_default_without_breaking_plain_jits(self,
+                                                               monkeypatch):
+        # NR_TPU_DEBUG=1 makes NodeReplicated default to debug=True; it
+        # must NOT arm checks inside plain (un-functionalized) jits —
+        # make_step and friends keep working
+        monkeypatch.setenv("NR_TPU_DEBUG", "1")
+        nr = NodeReplicated(make_hashmap(16), n_replicas=1,
+                            log_entries=64, gc_slack=8)
+        assert nr.debug
+        t = nr.register(0)
+        assert nr.execute_mut((HM_PUT, 1, 5), t) == 0
+        # plain unwrapped path still traces fine under the env var
+        from node_replication_tpu import LogSpec, log_init, make_step
+        from node_replication_tpu.core.replica import replicate_state
+
+        spec = LogSpec(capacity=64, n_replicas=1, arg_width=3, gc_slack=8)
+        step = make_step(make_hashmap(16), spec, 1, 1, donate=False)
+        log, st = log_init(spec), replicate_state(
+            make_hashmap(16).init_state(), 1
+        )
+        out = step(log, st,
+                   jnp.full((1, 1), HM_PUT, jnp.int32),
+                   jnp.zeros((1, 1, 3), jnp.int32),
+                   jnp.full((1, 1), HM_GET, jnp.int32),
+                   jnp.zeros((1, 1, 3), jnp.int32))
+        assert int(out[0].tail) == 1
+
+    def test_debug_off_matches_debug_on_results(self):
+        a = NodeReplicated(make_hashmap(32), n_replicas=2,
+                           log_entries=64, gc_slack=8)
+        b = NodeReplicated(make_hashmap(32), n_replicas=2,
+                           log_entries=64, gc_slack=8, debug=True)
+        for nr in (a, b):
+            t = nr.register(0)
+            for k in range(10):
+                nr.execute_mut((HM_PUT, k, k * 3), t)
+            nr.sync()
+        np.testing.assert_array_equal(
+            np.asarray(a.states["values"]), np.asarray(b.states["values"])
+        )

@@ -103,6 +103,136 @@ class TestVSpace:
         assert nr.execute((VS_IDENTIFY, 31), tok) == 51
 
 
+class TestVSpaceRadix:
+    def test_map_device_and_walk(self):
+        from node_replication_tpu.models import (
+            VSR_IDENTIFY,
+            VSR_MAP,
+            VSR_MAP_DEVICE,
+            VSR_RESOLVED,
+            VSR_TABLES,
+            make_vspace_radix,
+        )
+
+        d = make_vspace_radix(2048, max_span=8)
+        nr = NodeReplicated(d, n_replicas=2, log_entries=256, gc_slack=16)
+        tok = nr.register(0)
+        assert nr.execute_mut((VSR_MAP, 10, 100, 4), tok) == 4
+        # identify encodes (pframe+1) | device<<30 after a FULL walk
+        assert nr.execute((VSR_IDENTIFY, 10), tok) == 101
+        assert nr.execute((VSR_IDENTIFY, 13), tok) == 104
+        assert nr.execute((VSR_IDENTIFY, 14), tok) == -1
+        # device mapping carries the attribute bit (`benches/vspace.rs`
+        # MapDevice — uncacheable MMIO)
+        assert nr.execute_mut((VSR_MAP_DEVICE, 600, 7, 2), tok) == 2
+        resp = nr.execute((VSR_IDENTIFY, 600), tok)
+        assert resp == (8 | (1 << 30))
+        # RESOLVED is span-clipped (fixed scatter width) like the flat
+        # model: query per-region
+        assert nr.execute((VSR_RESOLVED, 8, 8), tok) == 4
+        assert nr.execute((VSR_RESOLVED, 600, 8), tok) == 2
+        # pages 10..13 live in PD table 0; 600 in table 1
+        assert nr.execute((VSR_TABLES,), tok) == 2
+        nr.sync()
+        assert nr.replicas_equal()
+
+    def test_unmap_table_tears_down_region(self):
+        from node_replication_tpu.models import (
+            VSR_IDENTIFY,
+            VSR_MAP,
+            VSR_RESOLVED,
+            VSR_TABLES,
+            VSR_UNMAP,
+            VSR_UNMAP_TABLE,
+            make_vspace_radix,
+        )
+
+        d = make_vspace_radix(2048, max_span=8)
+        nr = NodeReplicated(d, n_replicas=1, log_entries=512, gc_slack=16)
+        tok = nr.register(0)
+        nr.execute_mut((VSR_MAP, 0, 100, 8), tok)
+        nr.execute_mut((VSR_MAP, 510, 200, 4), tok)  # spans tables 0+1
+        assert nr.execute((VSR_TABLES,), tok) == 2
+        # plain unmap clears entries but keeps the table allocated
+        assert nr.execute_mut((VSR_UNMAP, 0, 4), tok) == 4
+        assert nr.execute((VSR_TABLES,), tok) == 2
+        # table teardown unmaps the whole 512-page region at once and
+        # deallocates the table (the radix-only region op)
+        assert nr.execute_mut((VSR_UNMAP_TABLE, 7), tok) == 6
+        assert nr.execute((VSR_TABLES,), tok) == 1
+        assert nr.execute((VSR_IDENTIFY, 511), tok) == -1
+        # table 1 intact: page 512 holds frame 202, encoded +1
+        assert nr.execute((VSR_IDENTIFY, 512), tok) == 203
+        assert nr.execute((VSR_RESOLVED, 510, 4), tok) == 2
+        # remapping reallocates a fresh table; no stale entries resurrect
+        assert nr.execute_mut((VSR_MAP, 100, 900, 1), tok) == 1
+        assert nr.execute((VSR_TABLES,), tok) == 2
+        assert nr.execute((VSR_IDENTIFY, 4), tok) == -1
+        assert nr.execute((VSR_IDENTIFY, 100), tok) == 901
+
+    def test_empty_map_allocates_no_tables(self):
+        from node_replication_tpu.models import (
+            VSR_MAP,
+            VSR_TABLES,
+            make_vspace_radix,
+        )
+
+        d = make_vspace_radix(2048, max_span=8)
+        nr = NodeReplicated(d, n_replicas=1, log_entries=64, gc_slack=8)
+        tok = nr.register(0)
+        assert nr.execute_mut((VSR_MAP, 0, 5, 0), tok) == 0  # npages=0
+        assert nr.execute((VSR_TABLES,), tok) == 0  # no phantom tables
+
+    def test_shadow_model_random_ops(self):
+        # random map/map-device/unmap/unmap-table stream vs a dict shadow
+        from node_replication_tpu.models import (
+            VSR_IDENTIFY,
+            VSR_MAP,
+            VSR_MAP_DEVICE,
+            VSR_UNMAP,
+            VSR_UNMAP_TABLE,
+            make_vspace_radix,
+        )
+
+        N, SPAN = 1536, 8
+        d = make_vspace_radix(N, max_span=SPAN)
+        nr = NodeReplicated(d, n_replicas=2, log_entries=1 << 12,
+                            gc_slack=64)
+        tok = nr.register(0)
+        rng = np.random.default_rng(4)
+        shadow = {}  # vpage -> (frame, device)
+        for _ in range(120):
+            op = rng.choice([VSR_MAP, VSR_MAP_DEVICE, VSR_UNMAP,
+                             VSR_UNMAP_TABLE], p=[0.4, 0.2, 0.3, 0.1])
+            v = int(rng.integers(0, N))
+            if op in (VSR_MAP, VSR_MAP_DEVICE):
+                f = int(rng.integers(0, 1 << 16))
+                n = int(rng.integers(1, SPAN + 1))
+                nr.execute_mut((op, v, f, n), tok)
+                for i in range(n):
+                    if v + i < N:
+                        shadow[v + i] = (f + i, op == VSR_MAP_DEVICE)
+            elif op == VSR_UNMAP:
+                n = int(rng.integers(1, SPAN + 1))
+                nr.execute_mut((op, v, n), tok)
+                for i in range(n):
+                    shadow.pop(v + i, None)
+            else:
+                nr.execute_mut((op, v), tok)
+                base = (v >> 9) << 9
+                for pg in range(base, min(base + 512, N)):
+                    shadow.pop(pg, None)
+        for v in rng.integers(0, N, 64):
+            got = nr.execute((VSR_IDENTIFY, int(v)), tok)
+            want = shadow.get(int(v))
+            if want is None:
+                assert got == -1, (v, got)
+            else:
+                assert got == ((want[0] + 1) | (int(want[1]) << 30)), v
+        nr.sync()
+        assert nr.replicas_equal()
+
+
 class TestMemFS:
     def test_write_read_truncate(self):
         d = make_memfs(4, 8)

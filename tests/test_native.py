@@ -121,13 +121,20 @@ class TestLogWrap:
 
         th = threading.Thread(target=appender, daemon=True)
         th.start()
+        # replica 1 stays dormant: the appender MUST stall at the ring's
+        # GC boundary and bump the counter (deterministic — syncing
+        # early would race the stall away)
+        deadline = time.time() + 10
+        while e.stuck_events() == 0 and time.time() < deadline:
+            time.sleep(0.001)
+        assert e.stuck_events() >= 1
+        # now release it: sync the dormant replica until the run finishes
         deadline = time.time() + 30
         while not done.is_set() and time.time() < deadline:
             e.sync(1)
             time.sleep(0.001)
         assert done.is_set()
         th.join()
-        assert e.stuck_events() >= 1
         e.sync()
         assert e.replicas_equal()
         e.close()
