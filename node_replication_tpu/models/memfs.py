@@ -23,6 +23,7 @@ Read opcodes:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from node_replication_tpu.ops.encoding import Dispatch
@@ -90,10 +91,211 @@ def make_memfs(n_files: int, n_blocks: int) -> Dispatch:
         fd = jnp.clip(args[0], 0, n_files - 1)
         return state["size"][fd]
 
+    def window_apply(state, opcodes, args):
+        """Combined replay for the FS (see `Dispatch.window_apply`).
+
+        Unlike the pure last-writer-wins models, memfs has two coupled
+        histories per file — block writes and whole-file truncates — and
+        running-size responses. The window still collapses to parallel
+        passes:
+
+        1. per-FILE segmented scan (sort by file, `associative_scan` over
+           max-affine elements `s → max(s·m, c)`) gives every op its
+           size-before/size-after and every position its
+           last-truncate-index-so-far;
+        2. per-CELL grouping (sort by file×block) gives every op the
+           last in-window write to its cell;
+        3. a logged read's value is its cell's last prior write UNLESS a
+           later truncate of the file intervened (then 0), else the
+           replica's initial block;
+        4. final state: per-cell last write survives only if it follows
+           the file's last truncate; final sizes are the scan results.
+
+        Bit-identical to folding write/truncate/read_logged in order
+        (tests/test_window.py::TestMemfsWindowApply).
+        """
+        W = opcodes.shape[0]
+        NEG = jnp.int64(-1)
+        fd = args[:, 0]
+        blk = args[:, 1]
+        val = args[:, 2]
+        is_wr = opcodes == FS_WRITE
+        is_tr = opcodes == FS_TRUNCATE
+        is_rd = opcodes == FS_READ_LOGGED
+        wr_ok = is_wr & _ok(fd, blk)
+        # truncate/read clip fd into range (matching the sequential ops)
+        fd_c = jnp.clip(fd, 0, n_files - 1)
+        blk_c = jnp.clip(blk, 0, n_blocks - 1)
+        idx = jnp.arange(W, dtype=jnp.int64)
+
+        # ---- pass 1: per-file segmented size scan -------------------
+        # ops that touch a file's size history: valid writes (max with
+        # blk+1), truncates (reset to 0). Logged READS ride the same
+        # ordering as identity elements — they change nothing but receive
+        # their last-truncate-before position from the shared scan (saves
+        # a whole third sort+scan per window). Everything else goes to a
+        # sentinel segment.
+        size_active = wr_ok | is_tr
+        f_eff = jnp.where(
+            size_active | is_rd, fd_c.astype(jnp.int64), n_files
+        )
+        order_f = jnp.argsort(f_eff * (W + 1) + idx)
+        sf = f_eff[order_f]
+        seg_start = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), sf[1:] != sf[:-1]]
+        )
+        # max-affine element (m, c): s → max(s + m, c) in max-plus form
+        # (m = 0 keep / -inf drop). write: (0, blk+1); truncate: (-inf, 0)
+        NINF = jnp.int64(-(1 << 40))
+        # write: (0, blk+1); truncate: (-inf, 0); read/other: identity
+        # (0, -inf)
+        m_el = jnp.where(is_tr[order_f], NINF, jnp.int64(0))
+        c_el = jnp.where(
+            is_tr[order_f],
+            jnp.int64(0),
+            jnp.where(wr_ok[order_f], (blk_c[order_f] + 1).astype(jnp.int64),
+                      NINF),
+        )
+        # segment-start folds in the file's initial size so the prefix
+        # IS the size-after value: element (0, s0) composed first
+        s0 = state["size"].at[
+            jnp.minimum(sf, n_files - 1).astype(jnp.int32)
+        ].get(mode="clip").astype(jnp.int64)
+        # compose a∘b (a then b): s → max(max(s+ma, ca)+mb, cb)
+        #                           = max(s + (ma+mb), max(ca+mb, cb))
+        def compose(a, b):
+            ma, ca, fa = a
+            mb, cb, fb = b
+            m = jnp.where(fb, mb, jnp.maximum(ma + mb, NINF))
+            c = jnp.where(fb, cb, jnp.maximum(ca + mb, cb))
+            return m, c, fa | fb
+
+        start_m = jnp.where(seg_start, NINF, m_el)
+        start_c = jnp.where(
+            seg_start,
+            # fold s0 through this element: max(s0 + m, c)
+            jnp.maximum(s0 + m_el, c_el),
+            c_el,
+        )
+        _, pc, _ = jax.lax.associative_scan(
+            compose, (start_m, start_c, seg_start)
+        )
+        # size AFTER each size-active op (sorted order); size BEFORE it
+        # = prefix up to the previous element (or s0 at segment start)
+        size_after_s = pc  # m of prefix applied to nothing: c carries it
+        prev_pc = jnp.concatenate([pc[:1] * 0, pc[:-1]])
+        size_before_s = jnp.where(seg_start, s0, prev_pc)
+        size_after = jnp.zeros((W,), jnp.int64).at[order_f].set(size_after_s)
+        size_before = jnp.zeros((W,), jnp.int64).at[order_f].set(
+            size_before_s
+        )
+        # running last-truncate index over the file-sorted order — used
+        # for the FINAL per-file truncate position (reads get their own
+        # pass below, which includes them in the ordering)
+        tr_idx_el = jnp.where(is_tr[order_f], idx[order_f], NEG)
+
+        def run_max(a, b):
+            va, fa = a
+            vb, fb = b
+            return jnp.where(fb, vb, jnp.maximum(va, vb)), fa | fb
+
+        tm, _ = jax.lax.associative_scan(run_max, (tr_idx_el, seg_start))
+        # each op's (exclusive) last-truncate-before — the logged reads'
+        # share of the ride
+        prev_tm = jnp.concatenate([jnp.full((1,), NEG), tm[:-1]])
+        last_tr_before_s = jnp.where(seg_start, NEG, prev_tm)
+        last_tr_before = jnp.full((W,), NEG).at[order_f].set(
+            last_tr_before_s
+        )
+
+        # final per-file: size = scan value at segment END; last truncate
+        # index overall = tm at segment end
+        seg_end = jnp.concatenate([sf[1:] != sf[:-1], jnp.ones((1,), bool)])
+        file_slot = jnp.where(
+            seg_end & (sf < n_files), sf, n_files
+        ).astype(jnp.int32)
+        new_size = state["size"].astype(jnp.int64).at[file_slot].set(
+            size_after_s, mode="drop"
+        )
+        last_tr_of_file = jnp.full((n_files + 1,), NEG).at[file_slot].set(
+            tm, mode="drop"
+        )[:n_files]
+
+        # ---- pass 2: per-cell grouping (writes + logged reads) ------
+        cell_active = wr_ok | is_rd
+        cell = jnp.where(
+            cell_active,
+            fd_c.astype(jnp.int64) * n_blocks + blk_c.astype(jnp.int64),
+            jnp.int64(n_files) * n_blocks,
+        )
+        order_c = jnp.argsort(cell * (W + 1) + idx)
+        sc = cell[order_c]
+        cstart = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), sc[1:] != sc[:-1]]
+        )
+        # running last-write (index) over the cell order, exclusive
+        w_idx_el = jnp.where(wr_ok[order_c], idx[order_c], NEG)
+        cm, _ = jax.lax.associative_scan(run_max, (w_idx_el, cstart))
+        prev_cm = jnp.concatenate([jnp.full((1,), NEG), cm[:-1]])
+        last_wr_before_s = jnp.where(cstart, NEG, prev_cm)
+        last_wr_before = jnp.full((W,), NEG).at[order_c].set(
+            last_wr_before_s
+        )
+
+        # ---- responses ----------------------------------------------
+        # write: new size (or -1 invalid); truncate: old size;
+        # read_logged: cell value just before it
+        j = last_wr_before  # candidate write feeding each logged read
+        k = last_tr_before  # its file's last truncate before it (pass 1)
+        init_val = state["data"][fd_c, blk_c]
+        rd_val = jnp.where(
+            j > k,
+            val[jnp.clip(j, 0).astype(jnp.int32)],
+            jnp.where(
+                k >= 0,
+                jnp.int32(0),
+                jnp.where(_ok(fd, blk), init_val, jnp.int32(-1)),
+            ),
+        )
+        # a read of an out-of-range (fd, blk) answers -1 regardless
+        rd_val = jnp.where(_ok(fd, blk), rd_val, jnp.int32(-1))
+        resps = jnp.where(
+            is_wr,
+            jnp.where(wr_ok, size_after.astype(jnp.int32), jnp.int32(-1)),
+            jnp.where(
+                is_tr,
+                size_before.astype(jnp.int32),
+                jnp.where(is_rd, rd_val, jnp.int32(0)),
+            ),
+        )
+
+        # ---- final state --------------------------------------------
+        # per-cell last write (idx, val): survives iff it follows the
+        # file's LAST truncate; truncated cells with no later write are 0
+        cell_wr = jnp.where(wr_ok, cell, jnp.int64(n_files) * n_blocks)
+        last_w = (
+            jnp.full((n_files * n_blocks + 1,), NEG)
+            .at[cell_wr].max(idx)[: n_files * n_blocks]
+            .reshape(n_files, n_blocks)
+        )
+        li = jnp.clip(last_w, 0).astype(jnp.int32)
+        lv = val[li]
+        ltr = last_tr_of_file[:, None]
+        data = jnp.where(
+            (last_w >= 0) & (last_w > ltr),
+            lv,
+            jnp.where(ltr >= 0, jnp.int32(0), state["data"]),
+        )
+        return {
+            "data": data,
+            "size": new_size.astype(jnp.int32),
+        }, resps
+
     return Dispatch(
         name=f"memfs{n_files}x{n_blocks}",
         make_state=make_state,
         write_ops=(write, truncate, read_logged),
         read_ops=(read, size),
         arg_width=3,
+        window_apply=window_apply,
     )

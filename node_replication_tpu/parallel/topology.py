@@ -7,6 +7,16 @@ hierarchy is device → host (process) → slice: intra-slice links are ICI,
 cross-slice is DCN. This module walks `jax.devices()` into the same kind of
 queryable topology object, and `allocate()` maps a replica/thread-placement
 strategy onto an ordered device list the mesh builder consumes.
+
+Thread pinning / DVFS (the remaining items of `benches/utils/mod.rs`:
+`pin_thread` at 26-31, `disable_dvfs` at 38-50) have no TPU analog by
+design, not by omission: "pinning" is device placement — the ordered
+device lists produced here ARE the pinning decision, consumed by
+`make_mesh`/`ShardedRunner` — and TPU cores have no OS-adjustable
+frequency governor to disable; clock management is firmware-controlled
+and uniform across a slice, so there is no DVFS knob whose variance a
+benchmark must suppress. The reference needs both only because its
+replicas are OS threads on frequency-scaled CPU cores.
 """
 
 from __future__ import annotations

@@ -126,6 +126,68 @@ class TestSortedSetWindowApply:
         assert [int(x) for x in got_resps] == ref_resps
 
 
+class TestMemfsWindowApply:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_matches_sequential_fold(self, seed):
+        # the hardest combined model: coupled per-file truncate and
+        # per-cell write histories plus running-size responses
+        from node_replication_tpu.models import make_memfs
+
+        F, B, W = 4, 6, 96
+        d = make_memfs(F, B)
+        rng = np.random.default_rng(seed)
+        opcodes = jnp.asarray(
+            rng.choice([0, 1, 2, 3, 9], size=W,
+                       p=[0.08, 0.42, 0.18, 0.27, 0.05]),
+            jnp.int32,
+        )
+        # include out-of-range fds/blocks to pin the clip/-1 semantics
+        args = jnp.asarray(
+            np.stack(
+                [rng.integers(-1, F + 1, W), rng.integers(-1, B + 1, W),
+                 rng.integers(1, 100, W)], axis=1
+            ),
+            jnp.int32,
+        )
+        state0 = d.init_state()
+        state0["data"] = state0["data"].at[1, :3].set(
+            jnp.asarray([11, 12, 13], jnp.int32)
+        )
+        state0["size"] = state0["size"].at[1].set(3)
+        ref_state, ref_resps = fold_reference(d, state0, opcodes, args)
+        got_state, got_resps = d.window_apply(state0, opcodes, args)
+        np.testing.assert_array_equal(
+            np.asarray(got_state["data"]), np.asarray(ref_state["data"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_state["size"]), np.asarray(ref_state["size"])
+        )
+        assert [int(x) for x in got_resps] == ref_resps
+
+    def test_truncate_then_write_then_logged_read(self):
+        from node_replication_tpu.models import make_memfs
+
+        d = make_memfs(2, 4)
+        state0 = d.init_state()
+        state0["data"] = state0["data"].at[0, 0].set(7)
+        state0["size"] = state0["size"].at[0].set(1)
+        ops = [
+            (3, 0, 0, 0),   # read 7 (initial)
+            (2, 0, 0, 0),   # truncate → old size 1
+            (3, 0, 0, 0),   # read 0 (truncated)
+            (1, 0, 2, 55),  # write block 2 → size 3
+            (3, 0, 2, 0),   # read 55 (in-window write)
+            (3, 0, 0, 0),   # read 0 (still truncated, no later write)
+        ]
+        opcodes = jnp.asarray([o[0] for o in ops], jnp.int32)
+        args = jnp.asarray([list(o[1:]) for o in ops], jnp.int32)
+        state, resps = d.window_apply(state0, opcodes, args)
+        assert [int(x) for x in resps] == [7, 1, 0, 3, 55, 0]
+        assert int(state["size"][0]) == 3
+        assert int(state["data"][0, 0]) == 0
+        assert int(state["data"][0, 2]) == 55
+
+
 class TestMultilogCombined:
     @pytest.mark.parametrize("seed", [0, 1])
     def test_partitioned_combined_matches_scan(self, seed):
