@@ -146,7 +146,12 @@ def make_memfs(n_files: int, n_blocks: int) -> Dispatch:
         )
         # max-affine element (m, c): s → max(s + m, c) in max-plus form
         # (m = 0 keep / -inf drop). write: (0, blk+1); truncate: (-inf, 0)
-        NINF = jnp.int64(-(1 << 40))
+        # big-negative sentinel with headroom for pairwise additions in
+        # `compose`; derived from the EFFECTIVE int dtype so the
+        # NR_TPU_NO_X64=1 opt-out (int64 canonicalized to int32) doesn't
+        # overflow a hard-coded literal
+        eff_i64 = jnp.zeros((), jnp.int64).dtype
+        NINF = jnp.asarray(jnp.iinfo(eff_i64).min // 4, eff_i64)
         # write: (0, blk+1); truncate: (-inf, 0); read/other: identity
         # (0, -inf)
         m_el = jnp.where(is_tr[order_f], NINF, jnp.int64(0))

@@ -3,6 +3,14 @@
 The shared library is compiled from `nr_native.cpp` with the system g++ the
 first time it is needed (and whenever the source is newer than the cached
 `.so`). No pip/pybind dependency: the C ABI is consumed with ctypes.
+
+Race detection (EXCEEDS the reference, which ships none — SURVEY.md §5
+"race detection: none"): set `NR_TPU_TSAN=1` before first import to
+compile with `-fsanitize=thread` and run the engine under
+ThreadSanitizer; `scripts/tsan_stress.py` drives the concurrency
+surfaces (flat combining, CNR per-log collection under the record
+seqlock, the distributed rwlock, multikey relaxed reads) under it.
+The TSAN build lands in a separate `.so` so the fast build is untouched.
 """
 
 from __future__ import annotations
@@ -14,7 +22,10 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "nr_native.cpp")
-_SO = os.path.join(_DIR, "libnr_native.so")
+_TSAN = os.environ.get("NR_TPU_TSAN", "") == "1"
+_SO = os.path.join(
+    _DIR, "libnr_native_tsan.so" if _TSAN else "libnr_native.so"
+)
 
 _lock = threading.Lock()
 _lib = None
@@ -35,10 +46,11 @@ def build(force: bool = False) -> str:
         cmd = [
             "g++",
             "-std=c++17",
-            "-O3",
+            "-O1" if _TSAN else "-O3",
             "-fPIC",
             "-shared",
             "-pthread",
+            *(["-fsanitize=thread", "-g"] if _TSAN else []),
             "-o",
             tmp,
             _SRC,
