@@ -159,16 +159,43 @@ class MultiLogReplicated:
     def execute_mut(self, op: tuple, token: ReplicaToken):
         """Route the write to its log, combine that log, return its
         response (`cnr/src/replica.rs:430-445`)."""
+        h = self.enqueue_mut(op, token)
+        self.combine(token.rid, h)
+        # The combine delivered this op's response last (it is the
+        # thread's newest append on its log); pop it from the tail so
+        # earlier enqueued-but-unread responses stay for `responses()`.
+        q = self._resps[(token.rid, token.tid)]
+        return q.pop() if q else None
+
+    def enqueue_mut(self, op: tuple, token: ReplicaToken) -> int:
+        """Stage a write without combining (explicit batch building, the
+        NodeReplicated twin). Its response arrives via `responses()`
+        after a later combine of its mapped log. Returns the mapped log
+        index (the staging path `execute_mut` shares)."""
         h = self._map(op)
         self._pending[(token.rid, token.tid)].append(
             (h, op[0], tuple(op[1:]))
         )
-        self.combine(token.rid, h)
-        resp = None
+        return h
+
+    def flush(self, rid: int | None = None) -> None:
+        """Combine every log with staged ops (all replicas by default)."""
+        for r in range(self.n_replicas) if rid is None else [rid]:
+            logs = {
+                h
+                for tid in range(self._threads_per_replica[r])
+                for (h, _, _) in self._pending[(r, tid)]
+            }
+            for h in sorted(logs):
+                self.combine(r, h)
+
+    def responses(self, token: ReplicaToken) -> list:
+        """Drain delivered responses for this thread (enqueue order per
+        log; delivery order across logs follows combine order)."""
         q = self._resps[(token.rid, token.tid)]
-        while q:
-            resp = q.popleft()
-        return resp
+        out = list(q)
+        q.clear()
+        return out
 
     def execute(self, op: tuple, token: ReplicaToken):
         """Read path: sync only the mapped log, then dispatch locally

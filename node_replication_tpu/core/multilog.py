@@ -48,6 +48,7 @@ from node_replication_tpu.ops.encoding import (
     apply_write,
     dispatch_reads,
 )
+from node_replication_tpu.utils.checks import check
 
 PyTree = Any
 
@@ -202,12 +203,16 @@ def multilog_exec_all(
     instead of a `window`-long scan — the multi-log form of the combined
     replay (`core/step.py`).
 
-    `lockstep=True` asserts the caller's precondition that every replica
+    `lockstep=True` declares the caller's precondition that every replica
     of a log starts at the same ltail (true inside `make_multilog_step`):
-    the combined path then gathers each log's window ONCE and shares its
-    sort across the replica vmap — without it the window (and its sort)
-    is recomputed per (log, replica) because ltails are formally
-    per-replica values.
+    the combined path then gathers each log's window ONCE (ltails[0]
+    speaks for the fleet) and shares its sort across the replica vmap —
+    without it the window (and its sort) is recomputed per (log, replica)
+    because ltails are formally per-replica values. The precondition is
+    verified only under debug checks (`utils/checks.check`, armed by
+    `debug_checks(True)` around a `checked()` trace — zero-cost
+    otherwise); an unchecked caller with divergent ltails silently gets
+    ltails[0] imposed on all replicas (ADVICE r3).
 
     Returns `(ml, states, resps[L, R, window])`.
     """
@@ -235,6 +240,11 @@ def multilog_exec_all(
             # stays UNBATCHED across the replica vmap
             def per_log(opc, arg, tail, sub_states, ltails):
                 lt0 = ltails[0]
+                check(
+                    jnp.all(ltails == lt0),
+                    "lockstep multilog replay requires equal per-replica "
+                    "ltails on every log",
+                )
                 opc_w, args_w = gather_window(
                     spec, opc, arg, lt0, tail, window
                 )
@@ -302,6 +312,7 @@ def make_multilog_step(
     jit: bool = True,
     donate: bool = True,
     combined: bool | None = None,
+    debug: bool = False,
 ):
     """Fused CNR step: per-log append → per-log replay → reads.
 
@@ -315,6 +326,11 @@ def make_multilog_step(
     Returns `(ml, states, wr_resps int32[L, R, B], rd_resps int32[R, Br])`.
     Precondition: all replicas synced on all logs at entry (true by
     induction when driven step-after-step).
+
+    `debug=True` compiles the device-side invariants (`utils/checks`,
+    here the lockstep equal-ltails precondition) into the program via
+    checkify and raises on violation — the `make_multilog_step` twin of
+    `NodeReplicated(debug=True)`. Donation is disabled in debug mode.
     """
     B = int(writes_per_log)
     Br = int(reads_per_replica)
@@ -333,6 +349,20 @@ def make_multilog_step(
         rd_resps = dispatch_reads(dispatch, states, rd_opcodes, rd_args)
         return ml, states, wr_resps, rd_resps
 
+    if debug:
+        from node_replication_tpu.utils.checks import checked, debug_checks
+
+        inner = checked(step)
+        if jit:
+            inner = jax.jit(inner)
+
+        def step_checked(*args):
+            with debug_checks(True):  # checks live at (re-)trace time
+                err, out = inner(*args)
+            err.throw()
+            return out
+
+        return step_checked
     if jit:
         step = jax.jit(step, donate_argnums=(0, 1) if donate else ())
     return step

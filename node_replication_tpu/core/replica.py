@@ -210,12 +210,10 @@ class NodeReplicated:
             self.combine(token.rid)
             ctx.enqueue(op[0], tuple(op[1:]))
         self.combine(token.rid)
-        resp = None
-        r = ctx.res()
-        while r is not None:  # drain any enqueue_mut backlog; last is ours
-            resp = r
-            r = ctx.res()
-        return resp
+        # This op is the thread's newest enqueue, so after the combine its
+        # response is the newest delivered. Earlier `enqueue_mut`
+        # responses stay queued, in order, for `responses()`.
+        return ctx.res_newest()
 
     def enqueue_mut(self, op: tuple, token: ReplicaToken) -> None:
         """Stage a write without combining (explicit flat-combining batch

@@ -96,8 +96,10 @@ def make_hashmap(n_keys: int, prefill_value: int | None = None) -> Dispatch:
         # bucket past the keyspace so they never touch real keys
         key_eff = jnp.where(active, k, n_keys).astype(jnp.int64)
         idx = jnp.arange(W, dtype=jnp.int64)
-        # stable key grouping: one sort key (key, window position)
-        order = jnp.argsort(key_eff * (W + 1) + idx)
+        # stable key grouping: argsort is stable, so equal keys keep
+        # window order — no composite `key*(W+1)+idx` key, which would
+        # overflow int32 under the NR_TPU_NO_X64=1 opt-out (ADVICE r3)
+        order = jnp.argsort(key_eff, stable=True)
         sk = key_eff[order]
         same_prev = jnp.concatenate(
             [jnp.zeros((1,), jnp.bool_), sk[1:] == sk[:-1]]

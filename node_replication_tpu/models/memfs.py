@@ -139,7 +139,9 @@ def make_memfs(n_files: int, n_blocks: int) -> Dispatch:
         f_eff = jnp.where(
             size_active | is_rd, fd_c.astype(jnp.int64), n_files
         )
-        order_f = jnp.argsort(f_eff * (W + 1) + idx)
+        # stable argsort keeps window order within a file — no composite
+        # sort key (overflows int32 under NR_TPU_NO_X64=1, ADVICE r3)
+        order_f = jnp.argsort(f_eff, stable=True)
         sf = f_eff[order_f]
         seg_start = jnp.concatenate(
             [jnp.ones((1,), jnp.bool_), sf[1:] != sf[:-1]]
@@ -233,7 +235,7 @@ def make_memfs(n_files: int, n_blocks: int) -> Dispatch:
             fd_c.astype(jnp.int64) * n_blocks + blk_c.astype(jnp.int64),
             jnp.int64(n_files) * n_blocks,
         )
-        order_c = jnp.argsort(cell * (W + 1) + idx)
+        order_c = jnp.argsort(cell, stable=True)
         sc = cell[order_c]
         cstart = jnp.concatenate(
             [jnp.ones((1,), jnp.bool_), sc[1:] != sc[:-1]]

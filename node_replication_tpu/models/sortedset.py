@@ -82,7 +82,9 @@ def make_sortedset(n_keys: int) -> Dispatch:
         active = is_ins | is_rem
         key_eff = jnp.where(active, k, n_keys).astype(jnp.int64)
         idx = jnp.arange(W, dtype=jnp.int64)
-        order = jnp.argsort(key_eff * (W + 1) + idx)
+        # stable sort on the key alone (composite key*(W+1)+idx overflows
+        # int32 under NR_TPU_NO_X64=1 — ADVICE r3)
+        order = jnp.argsort(key_eff, stable=True)
         sk = key_eff[order]
         same_prev = jnp.concatenate(
             [jnp.zeros((1,), jnp.bool_), sk[1:] == sk[:-1]]

@@ -639,7 +639,12 @@ static void combine(Engine *e, int rid, int li) {
       args[n][kArgW - 1] = (int32_t)(((uint32_t)tid << 8) | (uint32_t)j);
       n++;
     }
-    if (rec.seq.load(std::memory_order_acquire) != s1) {
+    // Canonical seqlock reader: an acquire fence orders the speculative
+    // plain reads above BEFORE the validating seq load — an acquire load
+    // alone does not order preceding reads (ADVICE r3; benign on
+    // x86-TSO, required by the C++ memory model).
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (rec.seq.load(std::memory_order_relaxed) != s1) {
       n = base;  // re-staged mid-scan: discard; a later pass collects it
       continue;
     }

@@ -34,6 +34,25 @@ class TestMultiLogReplicated:
         assert c.execute_mut((2, 5), t1) == 1
         assert c.execute((1, 5), t0) == -1
 
+    def test_execute_mut_preserves_enqueue_mut_backlog(self):
+        # CNR twin of the r3 VERDICT weak-#4 regression: execute_mut must
+        # return only its own response; earlier enqueue_mut responses
+        # (possibly on OTHER logs) stay queued for responses().
+        c = MultiLogReplicated(
+            make_hashmap(64), _key_mapper, nlogs=4, n_replicas=1,
+            log_entries=1 << 10, gc_slack=32,
+        )
+        t = c.register(0)
+        c.enqueue_mut((1, 0, 100), t)   # log 0, put → resp 0
+        c.enqueue_mut((1, 1, 101), t)   # log 1, put → resp 0
+        # routed to log 0: combines log 0, delivering the first backlog
+        # entry but NOT the log-1 one
+        assert c.execute_mut((2, 0), t) == 1    # remove k=0 → was present
+        assert c.responses(t) == [0]            # log-0 put only
+        c.flush()                               # combine remaining logs
+        assert c.responses(t) == [0]            # log-1 put arrives
+        assert c.execute((1, 1), t) == 101
+
     def test_ops_partition_over_logs(self):
         c = MultiLogReplicated(
             make_hashmap(64), _key_mapper, nlogs=4, n_replicas=1,
