@@ -7,7 +7,16 @@ default model is the 4-level radix (`make_vspace_radix`): Map / MapDevice
 (`benches/vspace.rs:176-481`); `--flat` selects the last-level-only
 variant. `--long-log` is the BASELINE.md long-log replay config: a big
 VA window, wide spans, large batches — deep replay windows per step.
+
+`--replay` selects the engine: `scan` is the faithful per-entry analog
+of the reference replay loop (`nr/src/log.rs:473-524`); `auto` (default)
+uses the models' combined window replay (r4): span expansion into
+page-events + the region-epoch algebra for table teardowns, one parallel
+reduction per window. Rows land in scaleout_benchmarks.csv with the
+engine suffix in the name so scan-vs-combined is a committed artifact.
 """
+
+import os
 
 from common import base_parser, finish_args
 
@@ -26,6 +35,14 @@ def main():
     p.add_argument("--long-log", action="store_true",
                    help="BASELINE.md long-log replay config: "
                         "pages=2^18, span=64, batch=1024")
+    p.add_argument("--replay",
+                   choices=["auto", "scan", "combined", "pallas"],
+                   default="auto",
+                   help="replay engine ('scan' = the faithful per-entry "
+                        "reference-loop analog; 'auto'/'combined' = the "
+                        "r4 combined window reduction; 'pallas' = the "
+                        "in-VMEM sequential span kernel, "
+                        "ops/pallas_vspace.py)")
     args = finish_args(p.parse_args())
     if args.long_log:
         pages = args.pages or (1 << 18)
@@ -34,10 +51,37 @@ def main():
     else:
         pages = args.pages or (1 << 24 if args.full else 1 << 18)
 
-    from node_replication_tpu.harness.mkbench import measure_step_runner
+    from node_replication_tpu.harness.mkbench import (
+        SCALEOUT_CSV,
+        _append_csv,
+        _CSV_FIELDS,
+        measure_step_runner,
+        sweep_rows,
+    )
     from node_replication_tpu.harness.trait import ReplicatedRunner
     from node_replication_tpu.harness.workloads import generate_batches
 
+
+    class PallasVspaceRunner(ReplicatedRunner):
+        """ReplicatedRunner with the replay swapped for the in-VMEM
+        sequential span kernel (`ops/pallas_vspace.py`); same log, same
+        honest dispatch accounting, pallas-layout state."""
+
+        def __init__(self, dispatch, pages, span, radix, R, Bw, Br):
+            from node_replication_tpu.ops.pallas_vspace import (
+                make_pallas_vspace_step,
+                pallas_vspace_state,
+            )
+
+            super().__init__(dispatch, R, Bw, Br, make_engine=False)
+            self.name = "nr-pallas"
+            self.step = make_pallas_vspace_step(
+                pages, self.spec, Bw, Br, span, radix=radix
+            )
+            self.states = pallas_vspace_state(pages, R, radix, None)
+
+    combined = {"auto": None, "scan": False, "combined": True,
+                "pallas": None}[args.replay]
     # write mix: maps dominate, with device maps, unmaps, and (radix)
     # table teardowns; npages rides args[1] and clips to --span
     wr_mix = (1, 1, 1, 2) if args.flat else (1, 1, 1, 2, 3, 4)
@@ -47,6 +91,7 @@ def main():
         else (lambda: make_vspace_radix(pages, max_span=args.span))
     )
     name = "vspace-flat" if args.flat else "vspace-radix"
+    rows = []
     for R in args.replicas:
         for batch in args.batch:
             spec = WorkloadSpec(keyspace=pages, write_ratio=75,
@@ -57,15 +102,27 @@ def main():
             # arg lanes: (vpage, pframe, npages) — give every op a real
             # span so maps/unmaps touch 1..span pages
             wr_args[..., 2] = 1 + (wr_args[..., 1] % args.span)
-            runner = ReplicatedRunner(model(), R, batch, 1)
+            if args.replay == "pallas":
+                runner = PallasVspaceRunner(
+                    model(), pages, args.span, not args.flat, R, batch, 1
+                )
+            else:
+                runner = ReplicatedRunner(model(), R, batch, 1,
+                                          combined=combined)
+                if args.replay != "auto":
+                    runner.name += f"-{args.replay}"
             res = measure_step_runner(
                 runner, wr_opc, wr_args, rd_opc, rd_args,
                 duration_s=args.duration,
             )
-            print(f">> {name}/nr R={R} batch={batch}: "
+            print(f">> {name}/{runner.name} R={R} batch={batch}: "
                   f"{res.client_mops:.2f} Mops client "
                   f"({res.mops:.2f} Mops replayed, pages touched "
                   f"<={args.span}/op)")
+            cfg = name + ("-longlog" if args.long_log else "")
+            rows.extend(sweep_rows(cfg, runner.name, res, R, 1, batch))
+    _append_csv(os.path.join(args.out_dir, SCALEOUT_CSV), _CSV_FIELDS,
+                rows)
 
 
 if __name__ == "__main__":

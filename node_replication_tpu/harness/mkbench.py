@@ -374,9 +374,6 @@ class ScaleBenchBuilder:
                             runner, *gen, duration_s=self._duration_s
                         )
                         results.append(res)
-                        disp_frac = res.total_dispatches / max(
-                            res.total_client_ops, 1
-                        )
                         print(
                             f">> {self.name}/{runner.name} R={R} "
                             f"logs={nlogs} batch={batch}: "
@@ -392,28 +389,36 @@ class ScaleBenchBuilder:
                                 f"{st['per_log_tail']} imbalance "
                                 f"{st['imbalance']:.2f}"
                             )
-                        for sec, ops in res.per_second:
-                            rows.append(
-                                {
-                                    "name": f"{self.name}/{runner.name}",
-                                    "rs": R,
-                                    "ls": nlogs,
-                                    "tm": (strat.value if strat is not None
-                                           else "none"),
-                                    "batch": batch,
-                                    "threads": R,
-                                    "duration": round(res.duration_s, 3),
-                                    "thread_id": -1,
-                                    "core_id": -1,
-                                    "second": sec,
-                                    "ops": ops,
-                                    "dispatches": int(ops * disp_frac),
-                                }
-                            )
+                        rows.extend(sweep_rows(
+                            self.name, runner.name, res, R, nlogs, batch,
+                            tm=(strat.value if strat is not None
+                                else "none"),
+                        ))
         _append_csv(
             os.path.join(self._out_dir, SCALEOUT_CSV), _CSV_FIELDS, rows
         )
         return results
+
+
+def sweep_rows(
+    name: str, runner_name: str, res, rs: int, ls: int, batch: int,
+    tm: str = "none",
+) -> list[dict]:
+    """Per-second CSV rows for one measured step-runner config — the
+    shared row shape of SCALEOUT_CSV (used by the ScaleBenchBuilder
+    sweep and by standalone benches like benches/vspace.py, so the
+    dispatches derivation cannot drift between them)."""
+    disp_frac = res.total_dispatches / max(res.total_client_ops, 1)
+    return [
+        {
+            "name": f"{name}/{runner_name}",
+            "rs": rs, "ls": ls, "tm": tm, "batch": batch,
+            "threads": rs, "duration": round(res.duration_s, 3),
+            "thread_id": -1, "core_id": -1, "second": sec,
+            "ops": ops, "dispatches": int(ops * disp_frac),
+        }
+        for sec, ops in res.per_second
+    ]
 
 
 def measure_native(

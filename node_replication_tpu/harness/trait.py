@@ -90,7 +90,8 @@ class ReplicatedRunner(FleetRunner):
                  writes_per_replica: int, reads_per_replica: int,
                  log_capacity: int | None = None,
                  track_resp: int | None = None,
-                 combined: bool | None = None):
+                 combined: bool | None = None,
+                 make_engine: bool = True):
         self.name = "nr"
         self.dispatch = dispatch
         self.n_replicas = n_replicas
@@ -102,10 +103,15 @@ class ReplicatedRunner(FleetRunner):
             arg_width=dispatch.arg_width,
             gc_slack=min(8192, span),
         )
-        self.step = make_step(dispatch, self.spec, self.Bw, self.Br,
-                              combined=combined)
+        # make_engine=False: a subclass brings its own step + states
+        # (e.g. the pallas vspace runner) — skip building the default
+        # engine and the replicated model state it would allocate
+        if make_engine:
+            self.step = make_step(dispatch, self.spec, self.Bw, self.Br,
+                                  combined=combined)
+            self.states = replicate_state(dispatch.init_state(),
+                                          n_replicas)
         self.log = log_init(self.spec)
-        self.states = replicate_state(dispatch.init_state(), n_replicas)
         # Each appended entry is replayed by every replica + local reads.
         self.dispatches_per_step = n_replicas * span + n_replicas * self.Br
         # A client write is one op regardless of replication.

@@ -23,6 +23,7 @@ Read opcodes:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from node_replication_tpu.ops.encoding import Dispatch
@@ -93,12 +94,97 @@ def make_vspace(n_pages: int, max_span: int = 16) -> Dispatch:
                       False)
         ).astype(jnp.int32)
 
+    def window_apply(state, opcodes, args):
+        """Combined replay for the flat vspace (see `Dispatch.window_apply`).
+
+        Map/Unmap are last-writer-wins *per page*; what makes vspace more
+        than the hashmap is that one op touches a whole span. Each op is
+        expanded into `max_span` page-EVENTS (lanes beyond the op's span
+        park at a sentinel page), after which the window is exactly the
+        hashmap algebra over W x max_span events:
+
+        1. group events by page with one stable sort,
+        2. presence-before(event) = same-page predecessor's stored value
+           != UNMAPPED, else the replica's initial frame,
+        3. per-op response = lane-sum of its events' presence bits
+           (newly-mapped for MAP, was-mapped for UNMAP),
+        4. final frames = per-page last event's stored value.
+
+        Bit-identical to folding vmap_/unmap over the window in order
+        (tests/test_window.py::TestVSpaceWindowApply). Replaces the
+        sequential replay loop (`nr/src/log.rs:473-524`) with O(E log E)
+        parallel work, E = W * max_span.
+        """
+        W = opcodes.shape[0]
+        S = max_span
+        vpage, pframe = args[:, 0], args[:, 1]
+        is_map = opcodes == VS_MAP
+        is_un = opcodes == VS_UNMAP
+        active = is_map | is_un
+        # MAP's span rides args[2]; UNMAP's rides args[1] (its arg tuple
+        # is (vpage, npages) — matching the sequential ops)
+        npages = jnp.where(is_un, args[:, 1], args[:, 2])
+        lanes = jnp.arange(S, dtype=jnp.int32)[None, :]
+        n = jnp.clip(npages, 0, S)[:, None]
+        raw = vpage[:, None] + lanes
+        lane_ok = (lanes < n) & (raw < n_pages) & active[:, None]
+        # mirror _span_idx exactly: negative vpage wraps through the mod
+        page = jnp.where(lane_ok, raw % n_pages, n_pages)
+        # MAP stores pframe+lane (which CAN be UNMAPPED=0 — a map to
+        # frame 0 reads back as unmapped, as in the sequential op);
+        # UNMAP stores 0
+        stored = jnp.where(is_map[:, None], pframe[:, None] + lanes,
+                           jnp.int32(0))
+        E = W * S
+        pe = page.reshape(E).astype(jnp.int64)
+        se = stored.reshape(E)
+        # stable sort by page: equal pages keep flattened (= window)
+        # order; no composite sort key (int32 overflow under the
+        # NR_TPU_NO_X64=1 opt-out, ADVICE r3)
+        order = jnp.argsort(pe, stable=True)
+        sp = pe[order]
+        same_prev = jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), sp[1:] == sp[:-1]]
+        )
+        prev = jnp.concatenate([order[:1], order[:-1]])
+        init_pres = (
+            state["frames"].at[
+                jnp.minimum(sp, n_pages - 1).astype(jnp.int32)
+            ].get(mode="clip")
+            != UNMAPPED
+        )
+        pres_before_s = jnp.where(
+            same_prev, se[prev] != UNMAPPED, init_pres
+        )
+        pres_before = (
+            jnp.zeros((E,), jnp.bool_).at[order].set(pres_before_s)
+            .reshape(W, S)
+        )
+        newly = jnp.sum(lane_ok & is_map[:, None] & ~pres_before, axis=1)
+        was = jnp.sum(lane_ok & is_un[:, None] & pres_before, axis=1)
+        resps = jnp.where(
+            is_map, newly, jnp.where(is_un, was, 0)
+        ).astype(jnp.int32)
+        # last event per page wins (sentinel slot absorbs parked lanes)
+        last = (
+            jnp.full((n_pages + 1,), -1, jnp.int64)
+            .at[pe].max(jnp.arange(E, dtype=jnp.int64))[:n_pages]
+        )
+        li = jnp.clip(last, 0).astype(jnp.int32)
+        frames = jnp.where(last >= 0, se[li], state["frames"])
+        return {"frames": frames}, resps
+
     return Dispatch(
         name=f"vspace{n_pages}",
         make_state=make_state,
         write_ops=(vmap_, unmap),
         read_ops=(identify, resolved),
         arg_width=3,
+        # degenerate config guard: with max_span > n_pages one op's
+        # mod-wrapped span can revisit a page, and the event expansion
+        # (one predecessor per event) diverges from the sequential fold
+        # -> fall back to the scan engine there
+        window_apply=window_apply if max_span <= n_pages else None,
     )
 
 
@@ -278,10 +364,284 @@ def make_vspace_radix(n_pages: int, max_span: int = 16) -> Dispatch:
     def tables(state, args):
         return jnp.sum(state["pd"]).astype(jnp.int32)
 
+    def window_apply(state, opcodes, args):
+        """Combined replay for the 4-level radix vspace.
+
+        The hardest window algebra in the repo (alongside memfs): four
+        COUPLED per-entry histories instead of one —
+
+          pt[p]    written by map/unmap lanes, bulk-cleared by
+                   UNMAP_TABLE over a 512-page region;
+          pd[r]    set by maps' table walks, cleared by UNMAP_TABLE;
+          pdpt/pml4  MONOTONE — only ever set (teardown stops at PD),
+                   so presence-before(t) is just first-set-time < t.
+
+        Decomposition into parallel passes, all bit-identical to the
+        sequential fold (tests/test_window.py::TestVSpaceRadixWindowApply):
+
+        1. *page stream* (W x max_span events): stable sort by page gives
+           every lane its same-page predecessor/successor write.
+        2. *region stream*: one stable sort by PD entry over interleaved
+           per-op [lane queries | table query | pd-mark updates | clear
+           update] columns (queries sort before their own op's updates, so
+           every query sees strictly-pre-op state). Three segmented
+           associative scans yield last-pd-update (pd presence-before),
+           last-clear-before (pt epoch start), and first-clear-after
+           (epoch assignment for teardown responses).
+        3. pt-before(lane) joins 1+2: the predecessor write wins iff it
+           postdates the last region clear, else cleared-0, else the
+           replica's initial pt.
+        4. UNMAP_TABLE's response — #fully-walked pages in its region,
+           pre-op — uses epoch algebra: each clear t on region r counts
+           (a) in-epoch pages whose LAST write before t is nonzero
+           (epoch-last markers scatter-added into a bucket keyed by their
+           first-clear-after = t) plus, when t is r's first clear, (b)
+           initially-mapped pages not yet written (per-region initial
+           census minus first-epoch touched pages), gated by the
+           region-uniform pml4/pdpt/pd walk bits.
+        5. final state: per-page last write vs last region clear; per-PD
+           last update; pdpt/pml4 = init | ever-set.
+
+        Every sort/scan depends only on the window, so under the step's
+        replica vmap they hoist out and are shared by the fleet.
+        """
+        W = opcodes.shape[0]
+        S = max_span
+        t_op = jnp.arange(W, dtype=jnp.int32)
+        vpage = args[:, 0] % n_pages
+        pframe = args[:, 1]
+        is_map = (opcodes == VSR_MAP) | (opcodes == VSR_MAP_DEVICE)
+        is_dev = opcodes == VSR_MAP_DEVICE
+        is_un = opcodes == VSR_UNMAP
+        is_tbl = opcodes == VSR_UNMAP_TABLE
+        # MAP spans ride args[2]; UNMAP's span rides args[1] (its arg
+        # tuple is (vpage, npages) — matching the sequential ops)
+        npages = jnp.where(is_un, args[:, 1], args[:, 2])
+        lanes = jnp.arange(S, dtype=jnp.int32)[None, :]
+        nn = jnp.clip(npages, 0, S)
+        raw = vpage[:, None] + lanes
+        lane_ok = (lanes < nn[:, None]) & (raw < n_pages) & (
+            is_map | is_un
+        )[:, None]
+        page = jnp.where(lane_ok, raw, n_pages)  # vpage>=0: mod is a no-op
+        stored = jnp.where(
+            is_map[:, None],
+            ((pframe[:, None] + lanes + 1) & _FRAME_MASK)
+            | jnp.where(is_dev[:, None], _DEV_BIT, 0),
+            jnp.int32(0),
+        )
+        safe = jnp.minimum(page, n_pages - 1)
+
+        # ---- level marks (mirrors _mark_levels' exact conditions) ----
+        live = is_map & (nn > 0)
+        last_pg = jnp.maximum(vpage + nn - 1, vpage)
+        pd_lanes = (vpage >> 9)[:, None] + jnp.arange(
+            _pd_w, dtype=jnp.int32
+        )[None, :]
+        pd_mark = jnp.where(
+            live[:, None]
+            & (pd_lanes <= (last_pg >> 9)[:, None])
+            & (pd_lanes < l2),
+            pd_lanes, l2,
+        )
+        hi = jnp.stack([vpage >> 18, last_pg >> 18], axis=1)
+        hi_mark = jnp.where(live[:, None] & (hi < l3), hi, l3)
+        top = jnp.stack([vpage >> 27, last_pg >> 27], axis=1)
+        top_mark = jnp.where(live[:, None] & (top < l4), top, l4)
+
+        # ---- monotone levels: first-set time per entry ---------------
+        tt2 = jnp.broadcast_to(t_op[:, None], (W, 2))
+        fs_pdpt = jnp.full((l3 + 1,), W, jnp.int32).at[hi_mark].min(tt2)[:l3]
+        fs_pml4 = jnp.full((l4 + 1,), W, jnp.int32).at[top_mark].min(
+            tt2
+        )[:l4]
+        init_pdpt, init_pml4 = state["pdpt"], state["pml4"]
+        init_pd, init_pt = state["pd"], state["pt"]
+
+        def pdpt_before(entry, t):
+            return init_pdpt[entry] | (fs_pdpt[entry] < t)
+
+        def pml4_before(entry, t):
+            return init_pml4[entry] | (fs_pml4[entry] < t)
+
+        # ---- page stream: same-page predecessor / successor ----------
+        E = W * S
+        pe = page.reshape(E).astype(jnp.int64)
+        se = stored.reshape(E)
+        te = jnp.broadcast_to(t_op[:, None], (W, S)).reshape(E)
+        ordp = jnp.argsort(pe, stable=True)
+        spg = pe[ordp]
+        samep = spg[1:] == spg[:-1]
+        prevp = jnp.concatenate([ordp[:1], ordp[:-1]])
+        nextp = jnp.concatenate([ordp[1:], ordp[-1:]])
+        f_ = jnp.zeros((1,), jnp.bool_)
+        unsort = lambda v, fill: jnp.full((E,), fill, v.dtype).at[ordp].set(v)
+        has_pred = unsort(jnp.concatenate([f_, samep]), False).reshape(W, S)
+        t_pred = unsort(te[prevp], 0).reshape(W, S)
+        v_pred = unsort(se[prevp], 0).reshape(W, S)
+        has_succ = unsort(jnp.concatenate([samep, f_]), False).reshape(W, S)
+        t_succ = unsort(te[nextp], 0).reshape(W, S)
+
+        # ---- region stream: [lane q | tbl q | pd marks | clear] ------
+        reg_tbl = vpage >> 9
+        tbl_q = jnp.where(is_tbl, reg_tbl, l2)[:, None]
+        clear_u = tbl_q
+        lane_q = jnp.where(lane_ok, page >> 9, l2)
+        Wc = S + 1 + _pd_w + 1
+        keys = jnp.concatenate([lane_q, tbl_q, pd_mark, clear_u], axis=1)
+        one_r = lambda v: jnp.broadcast_to(
+            jnp.asarray(v, jnp.bool_)[None, :], (W, Wc)
+        )
+        col_upd = one_r([False] * (S + 1) + [True] * (_pd_w + 1))
+        col_val = one_r([False] * (S + 1) + [True] * _pd_w + [False])
+        is_upd = col_upd & (keys < l2)
+        is_clear = is_upd & ~col_val
+        N = W * Wc
+        kz = keys.reshape(N).astype(jnp.int64)
+        tz = jnp.broadcast_to(t_op[:, None], (W, Wc)).reshape(N)
+        uz = is_upd.reshape(N)
+        vz = col_val.reshape(N)
+        cz = is_clear.reshape(N)
+        ordr = jnp.argsort(kz, stable=True)
+        skr = kz[ordr]
+        segf = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), skr[1:] != skr[:-1]]
+        )
+
+        def seg_last(a, b):
+            ta, va, ha, fa = a
+            tb, vb, hb, fb = b
+            tk = jnp.where(fb, tb, jnp.where(hb, tb, ta))
+            vk = jnp.where(fb, vb, jnp.where(hb, vb, va))
+            hk = jnp.where(fb, hb, ha | hb)
+            return tk, vk, hk, fa | fb
+
+        # last pd update (presence value) before each position
+        pt_, pv_, ph_, _ = jax.lax.associative_scan(
+            seg_last, (tz[ordr], vz[ordr], uz[ordr], segf)
+        )
+        # last CLEAR before each position (pt epoch start)
+        ct_, _, ch_, _ = jax.lax.associative_scan(
+            seg_last, (tz[ordr], vz[ordr], cz[ordr], segf)
+        )
+        # first clear AFTER each position: same scan over the reversal
+        segb = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), skr[::-1][1:] != skr[::-1][:-1]]
+        )
+        nt_, _, nh_, _ = jax.lax.associative_scan(
+            seg_last, (tz[ordr][::-1], vz[ordr][::-1], cz[ordr][::-1], segb)
+        )
+        nt_, nh_ = nt_[::-1], nh_[::-1]
+        unsR = lambda v, fill: jnp.full((N,), fill, v.dtype).at[ordr].set(v)
+        pd_has = unsR(ph_, False).reshape(W, Wc)
+        pd_val = unsR(pv_, False).reshape(W, Wc)
+        lcb = unsR(jnp.where(ch_, ct_, -1), -1).reshape(W, Wc)
+        nca = unsR(jnp.where(nh_, nt_, W), W).reshape(W, Wc)
+        init_pd_q = init_pd.at[
+            jnp.minimum(keys, l2 - 1).astype(jnp.int32)
+        ].get(mode="clip")
+        pd_b = jnp.where(pd_has, pd_val, init_pd_q)
+
+        # ---- per-lane walk-present just before its op ----------------
+        lane_pd_b = pd_b[:, :S]
+        lane_lcb = lcb[:, :S]
+        lane_nc = nca[:, :S]
+        pt_b = jnp.where(
+            has_pred & (t_pred > lane_lcb),
+            v_pred,
+            jnp.where(lane_lcb >= 0, 0, init_pt[safe]),
+        )
+        t_b = t_op[:, None]
+        walk_b = (
+            pml4_before(safe >> 27, t_b)
+            & pdpt_before(safe >> 18, t_b)
+            & lane_pd_b
+            & (pt_b != 0)
+        )
+        resp_map = jnp.sum(lane_ok & ~walk_b, axis=1)
+        resp_un = jnp.sum(lane_ok & walk_b, axis=1)
+
+        # ---- UNMAP_TABLE responses: epoch algebra --------------------
+        # a lane write is LAST-IN-ITS-EPOCH iff its next same-page write
+        # falls beyond the epoch's terminating clear
+        epoch_last = lane_ok & (~has_succ | (t_succ > lane_nc))
+        feeds = epoch_last & (lane_nc < W)
+        ncf = jnp.clip(lane_nc, 0, W).reshape(E)
+        a_bucket = jnp.zeros((W + 1,), jnp.int32).at[ncf].add(
+            (feeds & (stored != 0)).reshape(E)
+        )
+        init_nz_lane = init_pt[safe] != 0
+        b_sub = jnp.zeros((W + 1,), jnp.int32).at[ncf].add(
+            (feeds & (lane_lcb == -1) & init_nz_lane).reshape(E)
+        )
+        # per-region census of initially-mapped pages
+        padded = jnp.zeros((l2 * 512,), jnp.bool_).at[: n_pages].set(
+            init_pt != 0
+        )
+        init_nz_count = jnp.sum(
+            padded.reshape(l2, 512), axis=1
+        ).astype(jnp.int32)
+        c0 = lcb[:, S]
+        levels_tbl = (
+            pml4_before(jnp.minimum(reg_tbl >> 18, l4 - 1), t_op)
+            & pdpt_before(jnp.minimum(reg_tbl >> 9, l3 - 1), t_op)
+            & pd_b[:, S]
+        )
+        count_pt = a_bucket[t_op] + jnp.where(
+            c0 == -1, init_nz_count[reg_tbl] - b_sub[t_op], 0
+        )
+        resp_tbl = jnp.where(levels_tbl, count_pt, 0)
+
+        resps = jnp.where(
+            is_map, resp_map,
+            jnp.where(is_un, resp_un, jnp.where(is_tbl, resp_tbl, 0)),
+        ).astype(jnp.int32)
+
+        # ---- final state ---------------------------------------------
+        lastw = (
+            jnp.full((n_pages + 1,), -1, jnp.int64)
+            .at[pe].max(jnp.arange(E, dtype=jnp.int64))[:n_pages]
+        )
+        li = jnp.clip(lastw, 0).astype(jnp.int32)
+        lw_t, lw_v = te[li], se[li]
+        lc_reg = (
+            jnp.full((l2 + 1,), -1, jnp.int32)
+            .at[clear_u[:, 0]].max(jnp.where(is_tbl, t_op, -1))[:l2]
+        )
+        lc_pg = lc_reg[jnp.arange(n_pages) >> 9]
+        pt_new = jnp.where(
+            (lastw >= 0) & (lw_t > lc_pg),
+            lw_v,
+            jnp.where(lc_pg >= 0, 0, init_pt),
+        )
+        upd_keys = jnp.concatenate([pd_mark, clear_u], axis=1)
+        Uc = _pd_w + 1
+        upd_vals = jnp.broadcast_to(
+            jnp.asarray([True] * _pd_w + [False])[None, :], (W, Uc)
+        )
+        U = W * Uc
+        lastu = (
+            jnp.full((l2 + 1,), -1, jnp.int64)
+            .at[upd_keys.reshape(U).astype(jnp.int64)]
+            .max(jnp.arange(U, dtype=jnp.int64))[:l2]
+        )
+        pd_new = jnp.where(
+            lastu >= 0,
+            upd_vals.reshape(U)[jnp.clip(lastu, 0).astype(jnp.int32)],
+            init_pd,
+        )
+        pdpt_new = init_pdpt | (fs_pdpt < W)
+        pml4_new = init_pml4 | (fs_pml4 < W)
+        return {
+            "pt": pt_new, "pd": pd_new, "pdpt": pdpt_new,
+            "pml4": pml4_new,
+        }, resps
+
     return Dispatch(
         name=f"vspace_radix{n_pages}",
         make_state=make_state,
         write_ops=(map_, map_device, unmap, unmap_table),
         read_ops=(identify, resolved, tables),
         arg_width=3,
+        window_apply=window_apply,
     )

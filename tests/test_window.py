@@ -188,6 +188,168 @@ class TestMemfsWindowApply:
         assert int(state["data"][0, 2]) == 55
 
 
+def fold_jit(d, state, opcodes, args):
+    """fold_reference with a jitted per-op step (radix ops are slow
+    eagerly: 512-lane scatters per unmap_table)."""
+    step = jax.jit(lambda s, o, a: apply_write(d, s, o, a))
+    resps = []
+    for i in range(len(opcodes)):
+        state, r = step(state, opcodes[i], args[i])
+        resps.append(int(r))
+    return state, resps
+
+
+class TestVSpaceWindowApply:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_flat_matches_sequential_fold(self, seed):
+        from node_replication_tpu.models import make_vspace
+
+        K, S, W = 37, 5, 64
+        d = make_vspace(K, max_span=S)
+        rng = np.random.default_rng(seed)
+        opcodes = jnp.asarray(
+            rng.choice([0, 1, 2, 9], size=W, p=[0.1, 0.5, 0.3, 0.1]),
+            jnp.int32,
+        )
+        # adversarial args: negative/overflowing vpages (the sequential
+        # op wraps them through the mod), pframe=0 maps that read back
+        # as unmapped, zero/negative/oversized spans
+        args = jnp.asarray(
+            np.stack(
+                [rng.integers(-3, K + 3, W), rng.integers(0, 50, W),
+                 rng.integers(-1, S + 3, W)], axis=1
+            ),
+            jnp.int32,
+        )
+        st0 = d.init_state()
+        st0["frames"] = st0["frames"].at[::4].set(7)
+        ref_state, ref_resps = fold_jit(d, st0, opcodes, args)
+        got_state, got_resps = d.window_apply(st0, opcodes, args)
+        np.testing.assert_array_equal(
+            np.asarray(got_state["frames"]), np.asarray(ref_state["frames"])
+        )
+        assert [int(x) for x in got_resps] == ref_resps
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_radix_matches_sequential_fold(self, seed):
+        # the deepest window algebra: coupled pt/pd/pdpt/pml4 histories,
+        # region teardown epochs, span-crossing table marks
+        from node_replication_tpu.models import make_vspace_radix
+
+        P, S, W = 1500, 20, 96
+        d = make_vspace_radix(P, max_span=S)
+        rng = np.random.default_rng(seed)
+        opcodes = jnp.asarray(
+            rng.choice([0, 1, 2, 3, 4, 9], size=W,
+                       p=[0.06, 0.3, 0.14, 0.25, 0.2, 0.05]),
+            jnp.int32,
+        )
+        args = jnp.asarray(
+            np.stack(
+                [rng.integers(0, 2 * P, W), rng.integers(-2, 60, W),
+                 rng.integers(-1, S + 3, W)], axis=1
+            ),
+            jnp.int32,
+        )
+        st0 = d.init_state()
+        # torn init: full walk in region 0; pt WITHOUT pd in region 2
+        # (walk fails); pd with no pt in region 1
+        st0["pt"] = st0["pt"].at[10:40].set(5).at[1100:1130].set(9)
+        st0["pd"] = st0["pd"].at[0].set(True).at[1].set(True)
+        st0["pdpt"] = st0["pdpt"].at[0].set(True)
+        st0["pml4"] = st0["pml4"].at[0].set(True)
+        ref_state, ref_resps = fold_jit(d, st0, opcodes, args)
+        got_state, got_resps = d.window_apply(st0, opcodes, args)
+        for k in ("pt", "pd", "pdpt", "pml4"):
+            np.testing.assert_array_equal(
+                np.asarray(got_state[k]), np.asarray(ref_state[k]), k
+            )
+        assert [int(x) for x in got_resps] == ref_resps
+
+    def test_radix_teardown_epochs(self):
+        # directed epoch algebra: two teardowns of one region — the
+        # first counts initially-mapped + in-window pages, the second
+        # counts only pages re-mapped after the first
+        from node_replication_tpu.models import make_vspace_radix
+
+        P = 1100  # 3 pd regions (last one partial: 1100-1024=76 pages)
+        d = make_vspace_radix(P, max_span=8)
+        st0 = d.init_state()
+        # 6 initially fully-walked pages in region 1 (512..1023)
+        st0["pt"] = st0["pt"].at[600:606].set(3)
+        st0["pd"] = st0["pd"].at[1].set(True)
+        st0["pdpt"] = st0["pdpt"].at[0].set(True)
+        st0["pml4"] = st0["pml4"].at[0].set(True)
+        ops = [
+            (1, 520, 9, 4),   # map 4 fresh pages in region 1 → newly 4
+            (1, 602, 9, 4),   # overwrite 4 of the init pages → newly 0
+            (4, 700, 0, 0),   # teardown region 1 → 6 init + 4 new = 10
+            (3, 520, 4, 0),   # unmap after teardown → was 0
+            (1, 640, 1, 2),   # re-map 2 pages (re-allocates the table)
+            (4, 712, 0, 0),   # second teardown → only the 2 re-mapped
+            (4, 712, 0, 0),   # third, empty epoch → 0
+            (2, 76, 5, 3),    # MapDevice in region 0: pdpt/pml4 already
+                              # set, pd fresh → newly 3
+            (4, 100, 0, 0),   # teardown region 0 → 3
+        ]
+        opcodes = jnp.asarray([o[0] for o in ops], jnp.int32)
+        args = jnp.asarray([list(o[1:]) for o in ops], jnp.int32)
+        ref_state, ref_resps = fold_jit(d, st0, opcodes, args)
+        got_state, got_resps = d.window_apply(st0, opcodes, args)
+        assert ref_resps == [4, 0, 10, 0, 2, 2, 0, 3, 3]  # pin intent
+        assert [int(x) for x in got_resps] == ref_resps
+        for k in ("pt", "pd", "pdpt", "pml4"):
+            np.testing.assert_array_equal(
+                np.asarray(got_state[k]), np.asarray(ref_state[k]), k
+            )
+
+    def test_radix_step_combined_matches_scan(self):
+        # whole-step integration: combined engine vs scan engine over a
+        # multi-step drive with ring wrap
+        from node_replication_tpu.models import make_vspace_radix
+
+        R, Bw, Br, P, STEPS = 3, 4, 2, 1100, 5
+        d = make_vspace_radix(P, max_span=8)
+        spec = LogSpec(capacity=2 * R * Bw, n_replicas=R, arg_width=3,
+                       gc_slack=R * Bw // 2)
+        rng = np.random.default_rng(7)
+        s_comb = make_step(d, spec, Bw, Br, jit=True, donate=False,
+                           combined=True)
+        s_scan = make_step(d, spec, Bw, Br, jit=True, donate=False,
+                           combined=False)
+        log_c, st_c = log_init(spec), replicate_state(d.init_state(), R)
+        log_s, st_s = log_init(spec), replicate_state(d.init_state(), R)
+        for _ in range(STEPS):
+            wr_opc = jnp.asarray(
+                rng.choice([0, 1, 2, 3, 4], size=(R, Bw)), jnp.int32
+            )
+            wr_args = jnp.asarray(
+                np.stack([rng.integers(0, P, (R, Bw)),
+                          rng.integers(0, 60, (R, Bw)),
+                          rng.integers(0, 9, (R, Bw))], axis=-1),
+                jnp.int32,
+            )
+            rd_opc = jnp.asarray(
+                rng.choice([1, 2, 3], size=(R, Br)), jnp.int32
+            )
+            rd_args = jnp.asarray(
+                np.stack([rng.integers(0, P, (R, Br)),
+                          rng.integers(1, 9, (R, Br)),
+                          np.zeros((R, Br))], axis=-1),
+                jnp.int32,
+            )
+            log_c, st_c, wr_c, rd_c = s_comb(
+                log_c, st_c, wr_opc, wr_args, rd_opc, rd_args
+            )
+            log_s, st_s, wr_s, rd_s = s_scan(
+                log_s, st_s, wr_opc, wr_args, rd_opc, rd_args
+            )
+            np.testing.assert_array_equal(np.asarray(wr_c), np.asarray(wr_s))
+            np.testing.assert_array_equal(np.asarray(rd_c), np.asarray(rd_s))
+        for a, b in zip(jax.tree.leaves(st_c), jax.tree.leaves(st_s)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestMultilogCombined:
     @pytest.mark.parametrize("seed", [0, 1])
     def test_partitioned_combined_matches_scan(self, seed):
