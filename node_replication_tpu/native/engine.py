@@ -269,14 +269,24 @@ def bench_cmp(
 ) -> tuple[int, np.ndarray]:
     """Non-NR comparison baselines under the same splitmix workload loop
     as `bench_hashmap` (`benches/hashmap_comparisons.rs:25-176` analog):
-    'mutex' = one std::unordered_map behind a mutex; 'partitioned' = one
-    private map per thread over its key congruence class. Returns
-    (total_ops, per_thread_ops)."""
+    'mutex' = one std::unordered_map behind a mutex; 'lockfree' = a
+    shared lock-free open-addressing map (wait-free readers — the
+    urcu-class competitive middle of the reference's headline graphs,
+    `benches/hashmap_comparisons.rs:281-435`); 'partitioned' = one
+    private map per thread over its key congruence class (the no-sharing
+    ceiling). Returns (total_ops, per_thread_ops)."""
     from node_replication_tpu.native import load
 
+    if system == "lockfree" and keyspace > (1 << 26):
+        raise ValueError(
+            "lockfree cmp map caps keyspace at 2^26 (its fixed "
+            "open-addressing table would exceed 1 GiB); shrink --keys "
+            "for the comparison sweep"
+        )
     lib = load()
     fn = {
         "mutex": lib.nr_bench_cmp_mutex,
+        "lockfree": lib.nr_bench_cmp_lockfree,
         "partitioned": lib.nr_bench_cmp_partitioned,
     }[system]
     per = (ctypes.c_uint64 * n_threads)()

@@ -1141,4 +1141,93 @@ uint64_t nr_bench_cmp_partitioned(int n_threads, int write_pct,
   return total;
 }
 
+// A LOCK-FREE open-addressing concurrent map: the competitive middle the
+// reference's headline graphs lead with (urcu gets within ~2x of NR on
+// read-heavy loads, `benches/hashmap_comparisons.rs:281-435`;
+// `nr/README.md:85-96`). Design: power-of-two table of single
+// std::atomic<uint64_t> slots packing (key+1) << 32 | value32 — a slot
+// is CLAIMED and PUBLISHED in one CAS, updated with one store, and read
+// with one load, so readers are WAIT-FREE and can never observe a torn
+// (key, value) pair; writers are lock-free (the only loop is the probe,
+// and a lost CAS means another thread made progress). No deletion — the
+// bench workload is put/get, as in the reference's urcu comparison.
+// Capacity 2x the keyspace keeps probes short (load factor <= 50%).
+uint64_t nr_bench_cmp_lockfree(int n_threads, int write_pct,
+                               int64_t keyspace, int batch,
+                               int duration_ms, uint64_t seed,
+                               uint64_t *out_per_thread) {
+  if (keyspace < 1) keyspace = 1;
+  // table capacity is bounded (2^27 slots = 1 GiB); the Python wrapper
+  // rejects larger keyspaces instead of silently reshaping the workload
+  if (keyspace > (int64_t)1 << 26) return 0;
+  uint64_t cap = 1;
+  while (cap < (uint64_t)keyspace * 2) cap <<= 1;
+  const uint64_t mask = cap - 1;
+  std::vector<std::atomic<uint64_t>> table(cap);
+  for (auto &s : table) s.store(0, std::memory_order_relaxed);
+  std::vector<std::thread> ts;
+  std::vector<uint64_t> counts(n_threads, 0);
+  std::atomic<bool> go{false}, stop{false};
+  if (batch < 1) batch = 1;
+  for (int g = 0; g < n_threads; g++) {
+    ts.emplace_back([&, g]() {
+      uint64_t rng = seed + 0x1000 * g + 1;
+      while (!go.load(std::memory_order_acquire)) cpu_relax();
+      uint64_t done = 0;
+      volatile int64_t sink = 0;
+      while (!stop.load(std::memory_order_relaxed)) {
+        for (int j = 0; j < batch; j++) {
+          uint64_t r = splitmix(rng);
+          uint64_t key = r % (uint64_t)keyspace;
+          uint64_t tag = (key + 1) << 32;
+          // real hash mixing: without it, cap >= 2x keyspace gives every
+          // key a private home slot and the "map" degenerates into a
+          // direct-mapped atomic array (r4 review)
+          uint64_t h = key * 0x9e3779b97f4a7c15ull;
+          h ^= h >> 29;
+          bool is_write = (int)((r >> 40) % 100) < write_pct;
+          uint64_t packed = tag | (uint32_t)(r >> 33);
+          for (uint64_t probe = 0;; probe++) {
+            uint64_t idx = (h + probe) & mask;
+            uint64_t cur = table[idx].load(std::memory_order_acquire);
+            if ((cur & ~0xffffffffull) == tag) {  // key present
+              if (is_write)
+                table[idx].store(packed, std::memory_order_release);
+              else
+                sink = (int64_t)(cur & 0xffffffff);
+              break;
+            }
+            if (cur == 0) {  // empty slot ends the probe chain
+              if (!is_write) { sink = -1; break; }
+              uint64_t expect = 0;
+              if (table[idx].compare_exchange_strong(
+                      expect, packed, std::memory_order_acq_rel,
+                      std::memory_order_acquire))
+                break;
+              // lost the claim: re-examine this slot (expect holds it)
+              probe--;
+              continue;
+            }
+            // occupied by another key: keep probing (cap >= 2x keys, so
+            // a free slot always exists)
+          }
+          done++;
+        }
+      }
+      (void)sink;
+      counts[g] = done;
+    });
+  }
+  go.store(true, std::memory_order_release);
+  std::this_thread::sleep_for(std::chrono::milliseconds(duration_ms));
+  stop.store(true);
+  for (auto &t : ts) t.join();
+  uint64_t total = 0;
+  for (int g = 0; g < n_threads; g++) {
+    total += counts[g];
+    if (out_per_thread) out_per_thread[g] = counts[g];
+  }
+  return total;
+}
+
 }  // extern "C"

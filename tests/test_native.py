@@ -570,11 +570,21 @@ class TestComparisonBaselines:
         # (`benches/hashmap_comparisons.rs:25-176` analog).
         from node_replication_tpu.native import bench_cmp
 
-        for system in ("mutex", "partitioned"):
+        for system in ("mutex", "lockfree", "partitioned"):
             total, per = bench_cmp(system, 2, 50, 1024, duration_ms=100)
             assert total > 0
             assert len(per) == 2
             assert sum(per) == total
+
+    def test_cmp_lockfree_beats_mutex_read_heavy(self):
+        # the r4 competitive middle (`benches/hashmap_comparisons.rs:
+        # 281-435` urcu analog): wait-free readers must clearly beat the
+        # single-mutex floor on a read-heavy mix
+        from node_replication_tpu.native import bench_cmp
+
+        lf, _ = bench_cmp("lockfree", 4, 0, 4096, duration_ms=300)
+        mx, _ = bench_cmp("mutex", 4, 0, 4096, duration_ms=300)
+        assert lf > 1.5 * mx, (lf, mx)
 
     def test_cmp_unknown_system_rejected(self):
         import pytest
