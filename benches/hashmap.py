@@ -29,6 +29,9 @@ def main():
     p.add_argument("--baseline", action="store_true")
     p.add_argument("--skewed", action="store_true",
                    help="zipf keys instead of uniform")
+    p.add_argument("--logs", type=int, nargs="+", default=None,
+                   help="CNR log counts for --cmp (default [8]; e.g. "
+                        "--logs 2 4 8 for the skew-imbalance sweep)")
     p.add_argument("--sparse", action="store_true",
                    help="open-addressing map over a sparse keyspace "
                         "(models/oahashmap.py) with window-full drop "
@@ -37,6 +40,8 @@ def main():
                    help="--sparse: initial table slots (default 2x the "
                         "keyspace working set)")
     args = finish_args(p.parse_args())
+    if args.logs and not args.cmp:
+        p.error("--logs selects CNR log counts and needs --cmp")
 
     keys = args.keys or (1 << 22 if args.full else 10_000)
     dist = "skewed" if args.skewed else "uniform"
@@ -58,12 +63,18 @@ def main():
         (
             ScaleBenchBuilder(
                 lambda: make_hashmap(keys),
-                f"hashmap{keys}-wr{wr}",
+                (f"hashmap{keys}-wr{wr}-{dist}" if args.skewed
+                 else f"hashmap{keys}-wr{wr}"),
                 WorkloadSpec(keyspace=keys, write_ratio=wr,
                              distribution=dist, seed=args.seed),
             )
             .replicas(args.replicas)
-            .log_strategies([1] + ([8] if "cnr" in systems else []))
+            .log_strategies(
+                [1] + sorted(
+                    {L for L in (args.logs or [8]) if L > 1}
+                    if "cnr" in systems else set()
+                )
+            )
             .batches(args.batch)
             .systems(systems)
             .duration(args.duration)

@@ -51,6 +51,10 @@ def main():
     p = base_parser("CNR sorted-set log sweep")
     p.add_argument("--keys", type=int, default=None)
     p.add_argument("--logs", type=int, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--skewed", action="store_true",
+                   help="zipf keys instead of uniform (the per-log "
+                        "imbalance sweep; stats land in "
+                        "cnr_skew_stats.csv)")
     p.add_argument("--no-partition", action="store_true",
                    help="disable the parallel partitioned replay (fold "
                         "logs sequentially, the r1 behavior)")
@@ -61,12 +65,14 @@ def main():
                         "uses the combined window reduction)")
     args = finish_args(p.parse_args())
     keys = args.keys or (1 << 20 if args.full else 1 << 14)
+    dist = "skewed" if args.skewed else "uniform"
 
     builder = (
         ScaleBenchBuilder(
             lambda: make_sortedset(keys),
-            f"sortedset{keys}",
-            WorkloadSpec(keyspace=keys, write_ratio=80, seed=args.seed),
+            f"sortedset{keys}-{dist}" if args.skewed else f"sortedset{keys}",
+            WorkloadSpec(keyspace=keys, write_ratio=80, distribution=dist,
+                         seed=args.seed),
         )
         .replicas(args.replicas)
         .log_strategies(args.logs)

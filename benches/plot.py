@@ -19,6 +19,9 @@ from collections import defaultdict
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--csv", default="scaleout_benchmarks.csv")
+    p.add_argument("--skew-csv", default="cnr_skew_stats.csv",
+                   help="CNR per-log imbalance sidecar (plotted to "
+                        "cnr-skew-imbalance.png when present)")
     p.add_argument("--out", default=".")
     args = p.parse_args()
 
@@ -68,6 +71,44 @@ def main():
         ax.grid(alpha=0.3)
     fig.tight_layout()
     out = os.path.join(args.out, "throughput-vs-replicas.png")
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out}")
+    plot_skew(args, plt)
+
+
+def plot_skew(args, plt):
+    """CNR per-log imbalance: uniform vs zipf, by log count — the
+    phenomenon hash routing concentrates (`cnr/src/replica.rs:435`;
+    workload `benches/hashmap.rs:143-150`). Bars = max-tail/mean-tail
+    (1.0 = perfectly balanced); the line carries the replayed Mops so
+    the throughput cost of the hot log rides the same panel."""
+    if not os.path.exists(args.skew_csv):
+        return
+    rows = list(csv.DictReader(open(args.skew_csv)))
+    if not rows:
+        return
+    # last row per config wins (CSV accumulates across runs)
+    by_cfg = {}
+    for r in rows:
+        by_cfg[(r["distribution"], int(r["ls"]), r["name"].split("/")[-1],
+                int(r["rs"]), int(r["batch"]))] = r
+    cfgs = sorted(by_cfg)
+    labels = [f"{d}\nL={ls} {nm}\nR={rs} b{b}"
+              for d, ls, nm, rs, b in cfgs]
+    imb = [float(by_cfg[c]["imbalance"]) for c in cfgs]
+    mops = [float(by_cfg[c]["replay_mops"]) for c in cfgs]
+    fig, ax = plt.subplots(figsize=(max(6, len(cfgs) * 1.1), 3.6))
+    colors = ["#888888" if c[0] == "uniform" else "#c44e52" for c in cfgs]
+    ax.bar(range(len(cfgs)), imb, color=colors)
+    ax.axhline(1.0, color="k", lw=0.8, ls="--")
+    ax.set_xticks(range(len(cfgs)))
+    ax.set_xticklabels(labels, fontsize=6)
+    ax.set_ylabel("per-log imbalance (max/mean tail)")
+    ax2 = ax.twinx()
+    ax2.plot(range(len(cfgs)), mops, marker="o", color="#4c72b0", lw=1)
+    ax2.set_ylabel("Mops replayed", color="#4c72b0")
+    fig.tight_layout()
+    out = os.path.join(args.out, "cnr-skew-imbalance.png")
     fig.savefig(out, dpi=120)
     print(f"wrote {out}")
 

