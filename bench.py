@@ -74,7 +74,17 @@ def main():
                         "provides window_apply")
     p.add_argument("--pallas", action="store_true",
                    help="alias for --path pallas")
+    p.add_argument("--spread-threshold", type=float, default=5.0,
+                   help="max acceptable min-to-max spread (%%) across "
+                        "repeats; a noisier window is treated as "
+                        "CONTENDED and re-measured (VERDICT r3 weak #2)")
+    p.add_argument("--max-attempts", type=int, default=3,
+                   help="measurement windows to try before accepting a "
+                        "contended one (the cleanest attempt is "
+                        "reported either way)")
     args = p.parse_args()
+    if args.max_attempts < 1:
+        p.error("--max-attempts must be >= 1")
     if args.pallas:
         if args.path not in ("auto", "pallas"):
             p.error(f"--pallas conflicts with --path {args.path}")
@@ -161,20 +171,44 @@ def main():
     t_step = (time.perf_counter() - t0) / cal
     n_steps = max(cal, math.ceil(args.min_time / max(t_step, 1e-9)))
 
-    values = []
+    # Contention-aware measurement (VERDICT r3 weak #2): the tunneled
+    # chip is shared, so a window can land in a contended slot and carry
+    # a misleading spread. Measure up to --max-attempts windows; accept
+    # the first whose min-to-max spread across repeats is within
+    # --spread-threshold, else report the CLEANEST window with an
+    # explicit contended=true — the committed JSON always carries the
+    # most reproducible number the run could obtain, plus every
+    # attempt's median for the audit trail.
+    attempts = []
     with trace_span("bench-measure", steps=n_steps * args.repeats):
-        for _ in range(args.repeats):
-            start = time.perf_counter()
-            log, states = run(n_steps, log, states)
-            elapsed = time.perf_counter() - start
-            values.append(per_step * n_steps / elapsed)
-
-    value = statistics.median(values)
-    spread_pct = 100.0 * (max(values) - min(values)) / value
+        for attempt in range(args.max_attempts):
+            values = []
+            for _ in range(args.repeats):
+                start = time.perf_counter()
+                log, states = run(n_steps, log, states)
+                elapsed = time.perf_counter() - start
+                values.append(per_step * n_steps / elapsed)
+            med = statistics.median(values)
+            spread = 100.0 * (max(values) - min(values)) / med
+            attempts.append((spread, med, values))
+            if spread <= args.spread_threshold:
+                break
+            more = attempt + 1 < args.max_attempts
+            print(
+                f"# attempt {attempt + 1}: spread {spread:.1f}% > "
+                f"{args.spread_threshold}% — contended window"
+                + (", re-measuring" if more else
+                   "; out of attempts, reporting the cleanest"),
+                file=sys.stderr,
+            )
+    spread_pct, value, values = min(attempts, key=lambda a: a[0])
+    contended = spread_pct > args.spread_threshold
     get_tracer().emit(
-        "bench", replicas=R, steps=n_steps * args.repeats,
+        "bench", replicas=R,
+        steps=n_steps * args.repeats * len(attempts),
         repeats=args.repeats, steps_per_repeat=n_steps,
         ops_per_sec=value, spread_pct=spread_pct,
+        attempts=len(attempts), contended=contended,
         path=args.path,
     )
     print(
@@ -186,6 +220,9 @@ def main():
                 "vs_baseline": round(value / 1e7, 3),
                 "repeats": args.repeats,
                 "spread_pct": round(spread_pct, 2),
+                "contended": contended,
+                "attempts": len(attempts),
+                "attempt_medians": [round(m, 1) for _, m, _ in attempts],
                 "steps_timed": n_steps * args.repeats,
                 "path": args.path,
             }
@@ -197,6 +234,7 @@ def main():
         f"(~{per_step * n_steps / value:.2f}s/repeat) | {R} replicas x "
         f"(span {span} replayed + {Br} reads) = {per_step} dispatches/step "
         f"| spread {spread_pct:.1f}% {[f'{v:.4g}' for v in values]} | "
+        f"attempts {len(attempts)}{' CONTENDED' if contended else ''} | "
         f"device={jax.devices()[0].device_kind}",
         file=sys.stderr,
     )

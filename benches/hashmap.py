@@ -42,6 +42,8 @@ def main():
     args = finish_args(p.parse_args())
     if args.logs and not args.cmp:
         p.error("--logs selects CNR log counts and needs --cmp")
+    if args.logs and not any(L > 1 for L in args.logs):
+        p.error("--logs needs at least one value > 1 (CNR log counts)")
 
     keys = args.keys or (1 << 22 if args.full else 10_000)
     dist = "skewed" if args.skewed else "uniform"

@@ -105,7 +105,9 @@ def plot_skew(args, plt):
     ax.set_xticklabels(labels, fontsize=6)
     ax.set_ylabel("per-log imbalance (max/mean tail)")
     ax2 = ax.twinx()
-    ax2.plot(range(len(cfgs)), mops, marker="o", color="#4c72b0", lw=1)
+    # markers only: the x axis is categorical (distribution/log-count/
+    # config groups), a connecting line would fake a trend across them
+    ax2.plot(range(len(cfgs)), mops, marker="o", color="#4c72b0", lw=0)
     ax2.set_ylabel("Mops replayed", color="#4c72b0")
     fig.tight_layout()
     out = os.path.join(args.out, "cnr-skew-imbalance.png")
