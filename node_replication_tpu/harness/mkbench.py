@@ -33,6 +33,7 @@ from node_replication_tpu.harness.trait import (
     NativeRunner,
     PartitionedRunner,
     ReplicatedRunner,
+    ShardedCnrRunner,
     ShardedRunner,
 )
 from node_replication_tpu.harness.workloads import (
@@ -304,7 +305,8 @@ class ScaleBenchBuilder:
         if system == "nr" and nlogs == 1:
             return ReplicatedRunner(d, R, bw, br, self._log_capacity,
                                     combined=combined)
-        if system == "cnr" and nlogs > 1:
+        if system in ("cnr", "sharded-cnr") and nlogs > 1:
+            label = f"{system}{nlogs}"
             part = None
             if self._partitioned_factory is not None:
                 try:
@@ -313,18 +315,26 @@ class ScaleBenchBuilder:
                     # e.g. keyspace not divisible by this swept nlogs:
                     # fall back to the sequential fold rather than
                     # aborting the whole sweep mid-run.
-                    print(f"## cnr{nlogs}: partitioned replay unavailable "
+                    print(f"## {label}: partitioned replay unavailable "
                           f"({e}); using sequential fold")
             if combined and part is None:
                 # never mislabel: a forced-combined config without a
                 # partitioned model would silently measure the scan fold
-                print(f"## cnr{nlogs}: skipping — replay 'combined' "
+                print(f"## {label}: skipping — replay 'combined' "
                       f"forced but no partitioned model")
                 return None
-            return MultiLogRunner(d, R, nlogs, bw, br, self._log_capacity,
-                                  partitioned=part,
-                                  keyspace=self.workload.keyspace,
-                                  combined=combined)
+            cls = (ShardedCnrRunner if system == "sharded-cnr"
+                   else MultiLogRunner)
+            try:
+                return cls(d, R, nlogs, bw, br, self._log_capacity,
+                           partitioned=part,
+                           keyspace=self.workload.keyspace,
+                           combined=combined)
+            except ValueError as e:
+                # e.g. the fleet does not divide over the mesh rows:
+                # skip this config (parity with the 'sharded' branch)
+                print(f"## {label}: skipping — {e}")
+                return None
         if system == "partitioned" and nlogs == 1:
             return PartitionedRunner(d, R, bw, br)
         if system == "concurrent" and nlogs == 1:
@@ -371,7 +381,8 @@ class ScaleBenchBuilder:
                         if runner is None:
                             continue
                         if (self._replay != "auto"
-                                and system in ("nr", "cnr")):
+                                and system in ("nr", "cnr",
+                                               "sharded-cnr")):
                             runner.name += f"-{self._replay}"
                         gen = generate_batches(
                             self.workload, self._steps, R, bw, br

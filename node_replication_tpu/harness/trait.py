@@ -214,16 +214,28 @@ class MultiLogRunner(FleetRunner):
                 "window_apply runs on state partitions); the "
                 "partitioned=None fold path is scan-only"
             )
-        self.step = make_multilog_step(
+        self.ml = multilog_init(self.spec)
+        self.states = replicate_state(
+            self.dispatch.init_state(), self.n_replicas
+        )
+        self.step = self._jit_step(B)
+
+    def _jit_step(self, B: int):
+        """Build the jitted step (hook: ShardedCnrRunner re-jits with
+        mesh shardings and places self.ml/self.states on the mesh)."""
+        return make_multilog_step(
             self.dispatch, self.spec, B, self.Br,
             partitioned=self.partitioned,
             combined=self.combined if self.partitioned is not None
             else None,
         )
-        self.ml = multilog_init(self.spec)
-        self.states = replicate_state(
-            self.dispatch.init_state(), self.n_replicas
-        )
+
+    def _place_streams(self, opc_b, args_b, counts, rd_opc, rd_args):
+        """Stage the routed streams on device (hook: the sharded runner
+        pins them to mesh axes instead)."""
+        self._w = (jnp.asarray(opc_b), jnp.asarray(args_b))
+        self._counts = jnp.asarray(counts, jnp.int64)
+        self._r = (jax.device_put(rd_opc), jax.device_put(rd_args))
 
     def prepare(self, wr_opc, wr_args, rd_opc, rd_args):
         S = wr_opc.shape[0]
@@ -256,9 +268,7 @@ class MultiLogRunner(FleetRunner):
         else:
             opc_b, args_b, counts = self._hash_routed(flat_opc, flat_args)
         self._build(opc_b.shape[2])
-        self._w = (jnp.asarray(opc_b), jnp.asarray(args_b))
-        self._counts = jnp.asarray(counts, jnp.int64)
-        self._r = (jax.device_put(rd_opc), jax.device_put(rd_args))
+        self._place_streams(opc_b, args_b, counts, rd_opc, rd_args)
         # Appended entries per step from the ACTUAL routed counts (they
         # sum to N for hash routing, and to L*ceil(N/L) for the tiled
         # rebalance) — each is one client write, replayed by every
@@ -510,6 +520,130 @@ class ShardedRunner(ReplicatedRunner):
         sh = NamedSharding(self.mesh, P(None, "replica"))
         self._w = (jax.device_put(wr_opc, sh), jax.device_put(wr_args, sh))
         self._r = (jax.device_put(rd_opc, sh), jax.device_put(rd_args, sh))
+
+
+class ShardedCnrRunner(MultiLogRunner):
+    """CNR MultiLog sharded over a ('replica', 'log') device mesh — the
+    multi-chip form of the more-combiners-need-more-chips story
+    (`cnr/src/replica.rs:93-98`): each log's ring, cursors, and routed
+    write buckets live in their own mesh column (the per-log append and
+    replay run WITHOUT cross-log traffic), replica states shard over the
+    'replica' axis, and XLA places the collectives that join them. The
+    configuration `__graft_entry__.dryrun_multichip` path C proves
+    correct on the virtual mesh is hereby drivable from
+    `ScaleBenchBuilder` (`systems(["sharded-cnr"])`): on an L-chip mesh
+    each combiner owns a chip; on one real chip it degrades to a 1x1
+    mesh (same program, GSPMD inserts nothing) so the sweep stays
+    runnable today and becomes a measurement the day multi-chip hardware
+    exists. Routing, padding, stats, and accounting are inherited from
+    MultiLogRunner — only device placement differs.
+    """
+
+    def __init__(self, dispatch: Dispatch, n_replicas: int, nlogs: int,
+                 writes_per_replica: int, reads_per_replica: int,
+                 log_capacity: int | None = None,
+                 n_log_shards: int | None = None,
+                 n_replica_shards: int | None = None,
+                 partitioned=None, keyspace: int | None = None,
+                 combined: bool | None = None):
+        super().__init__(
+            dispatch, n_replicas, nlogs, writes_per_replica,
+            reads_per_replica, log_capacity, partitioned=partitioned,
+            keyspace=keyspace, combined=combined,
+        )
+        from node_replication_tpu.parallel.mesh import make_mesh
+
+        n_dev = len(jax.devices())
+        if n_log_shards is None:
+            # prefer the log axis (the CNR scaling story): split the
+            # logs over every device when they divide evenly, give each
+            # log its own column when the devices over-provision, else
+            # leave the log axis unsharded
+            if nlogs % n_dev == 0:
+                n_log_shards = n_dev
+            elif n_dev % nlogs == 0:
+                n_log_shards = nlogs
+            else:
+                n_log_shards = 1
+        if n_replica_shards is None:
+            # widest replica split the fleet actually divides into
+            # (an unused remainder of the device grid is fine)
+            cap = max(1, n_dev // n_log_shards)
+            n_replica_shards = next(
+                r for r in range(min(cap, n_replicas), 0, -1)
+                if n_replicas % r == 0
+            )
+        if nlogs % n_log_shards:
+            raise ValueError(
+                f"L={nlogs} logs cannot shard over {n_log_shards} mesh "
+                f"columns"
+            )
+        if n_replicas % n_replica_shards:
+            raise ValueError(
+                f"R={n_replicas} replicas cannot shard over "
+                f"{n_replica_shards} mesh rows"
+            )
+        used = n_replica_shards * n_log_shards
+        self.mesh = make_mesh(
+            n_replica_shards, n_log_shards,
+            devices=jax.devices()[:used],
+        )
+        self.name = (
+            f"sharded-cnr{nlogs}"
+            + ("p" if partitioned is not None else "")
+            + f"-mesh{n_replica_shards}x{n_log_shards}"
+        )
+
+    def _jit_step(self, B: int):
+        # jit the step with mesh shardings and place the state the base
+        # _build created (per-log batches/counts ride 'log', read
+        # batches ride 'replica' — dryrun_multichip path C's layout)
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from node_replication_tpu.core.multilog import make_multilog_step
+        from node_replication_tpu.parallel.mesh import (
+            _log_spec_tree,
+            _states_spec_tree,
+            place,
+        )
+
+        base = make_multilog_step(
+            self.dispatch, self.spec, B, self.Br,
+            partitioned=self.partitioned,
+            combined=self.combined if self.partitioned is not None
+            else None,
+            jit=False,
+        )
+        self.ml, self.states = place(self.ml, self.states, self.mesh)
+        logsh = NamedSharding(self.mesh, P("log"))
+        repsh = NamedSharding(self.mesh, P("replica"))
+        self._logsh = NamedSharding(self.mesh, P(None, "log"))
+        self._repsh = NamedSharding(self.mesh, P(None, "replica"))
+        return jax.jit(
+            base,
+            in_shardings=(
+                _log_spec_tree(self.ml, self.mesh),
+                _states_spec_tree(self.states, self.mesh),
+                logsh, logsh, logsh, repsh, repsh,
+            ),
+            donate_argnums=(0, 1),
+        )
+
+    def _place_streams(self, opc_b, args_b, counts, rd_opc, rd_args):
+        # one transfer per stream, straight onto its mesh axis
+        # ([S, L, ...] on 'log'; [S, R, ...] on 'replica')
+        self._w = (
+            jax.device_put(jnp.asarray(opc_b), self._logsh),
+            jax.device_put(jnp.asarray(args_b), self._logsh),
+        )
+        self._counts = jax.device_put(
+            jnp.asarray(counts, jnp.int64), self._logsh
+        )
+        self._r = (
+            jax.device_put(rd_opc, self._repsh),
+            jax.device_put(rd_args, self._repsh),
+        )
 
 
 class NativeRunner:

@@ -82,3 +82,108 @@ class TestShardedStep:
     def test_mesh_shape_validation(self, devices):
         with pytest.raises(ValueError):
             make_mesh(3, 2, devices=devices[:8])
+
+
+class TestShardedCnrRunner:
+    """The multi-chip CNR configuration (dryrun path C) as a HARNESS
+    runner (VERDICT r3 #8): logs shard over the mesh 'log' axis and the
+    sweep can drive it via systems(["sharded-cnr"])."""
+
+    def _streams(self, S, R, Bw, Br, K, seed=3):
+        rng = np.random.default_rng(seed)
+        wr_opc = rng.choice([0, 1, 2], size=(S, R, Bw)).astype(np.int32)
+        wr_args = np.zeros((S, R, Bw, 3), np.int32)
+        wr_args[..., 0] = rng.integers(0, K, (S, R, Bw))
+        wr_args[..., 1] = rng.integers(1, 99, (S, R, Bw))
+        rd_opc = np.full((S, R, Br), 1, np.int32)
+        rd_args = np.zeros((S, R, Br, 3), np.int32)
+        rd_args[..., 0] = rng.integers(0, K, (S, R, Br))
+        return wr_opc, wr_args, rd_opc, rd_args
+
+    def test_matches_unsharded_multilog(self, devices):
+        # bit-identical to MultiLogRunner on the 8-device virtual mesh:
+        # placement must not change results
+        from node_replication_tpu.harness.trait import (
+            MultiLogRunner,
+            ShardedCnrRunner,
+        )
+        from node_replication_tpu.models import make_hashmap
+
+        K, L, R, S, Bw, Br = 64, 4, 8, 5, 6, 2
+        streams = self._streams(S, R, Bw, Br, K)
+        outs = {}
+        for cls in (MultiLogRunner, ShardedCnrRunner):
+            r = cls(make_hashmap(K), R, L, Bw, Br, keyspace=K)
+            r.prepare(*streams)
+            reads = []
+            for s in range(S):
+                r.run_step(s)
+                reads.append(np.asarray(r._last))
+            r.block()
+            outs[cls.__name__] = (
+                jax.tree.map(np.asarray, r.states),
+                np.asarray(r.ml.tail),
+                reads,
+                r.stats()["per_log_tail"],
+            )
+        a, b = outs["MultiLogRunner"], outs["ShardedCnrRunner"]
+        for x, y in zip(jax.tree.leaves(a[0]), jax.tree.leaves(b[0])):
+            np.testing.assert_array_equal(x, y)
+        np.testing.assert_array_equal(a[1], b[1])
+        for x, y in zip(a[2], b[2]):
+            np.testing.assert_array_equal(x, y)
+        assert a[3] == b[3]
+
+    def test_log_axis_sharding_is_real(self, devices):
+        # the per-log rings must actually be placed across the 'log'
+        # mesh axis when L divides the device count
+        from node_replication_tpu.harness.trait import ShardedCnrRunner
+        from node_replication_tpu.models import make_hashmap
+
+        K, L, R = 32, 8, 8
+        r = ShardedCnrRunner(make_hashmap(K), R, L, 4, 1, keyspace=K)
+        assert dict(zip(r.mesh.axis_names, r.mesh.devices.shape)) == {
+            "replica": 1, "log": 8,
+        }
+        streams = self._streams(3, R, 4, 1, K)
+        r.prepare(*streams)
+        sh = r.ml.opcodes.sharding
+        spec = getattr(sh, "spec", None)
+        assert spec is not None and tuple(spec)[0] == "log", sh
+        r.run_step(0)
+        r.block()
+
+    def test_undersized_log_count_still_shards(self, devices):
+        # L=4 on 8 devices: each log gets its own column and the
+        # replica axis takes the remainder (2x4), instead of silently
+        # leaving the log axis unsharded (r4 review)
+        from node_replication_tpu.harness.trait import ShardedCnrRunner
+        from node_replication_tpu.models import make_hashmap
+
+        r = ShardedCnrRunner(make_hashmap(32), 8, 4, 4, 1, keyspace=32)
+        assert dict(zip(r.mesh.axis_names, r.mesh.devices.shape)) == {
+            "replica": 2, "log": 4,
+        }
+
+    def test_builder_drives_sharded_cnr(self, devices):
+        from node_replication_tpu.harness import (
+            ScaleBenchBuilder,
+            WorkloadSpec,
+        )
+        from node_replication_tpu.models import make_hashmap
+
+        res = (
+            ScaleBenchBuilder(
+                lambda: make_hashmap(64), "shardedcnr-smoke",
+                WorkloadSpec(keyspace=64, write_ratio=50, seed=0),
+            )
+            .replicas([8])
+            .log_strategies([4])
+            .batches([8])
+            .systems(["sharded-cnr"])
+            .duration(0.2)
+            .out_dir("/tmp/shcnr-test")
+            .run()
+        )
+        assert len(res) == 1
+        assert res[0].total_dispatches > 0
