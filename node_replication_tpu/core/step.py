@@ -16,8 +16,13 @@ compiled XLA program per step that
    `ltail >= ctail` read gate of the reference in lock-step form.
 
 Precondition: all replicas are synced (`ltails == tail`) when the step
-begins — true by induction since each step replays exactly what it appends.
-Use `NodeReplicated` when replicas drift.
+begins AND hold identical states — both true by induction since every
+replica replays exactly what the fused step appends, from identical
+init. The combined engines lean on this: `window_plan` (stack, queue)
+computes the window's sorts ONCE from replica 0 and would silently
+impose replica 0's results on a hand-built fleet with divergent
+buffers. Use `NodeReplicated` when replicas drift — its catch-up replay
+takes the scan path.
 
 The returned step function is pure and shape-stable, so it can be jitted
 with sharding annotations (see `node_replication_tpu/parallel/mesh.py`) to
@@ -78,11 +83,21 @@ def make_step(
             f"step appends {span} entries but log fits {max_batch}; "
             f"grow LogSpec.capacity or shrink the per-step batch"
         )
-    if combined is None:
-        combined = dispatch.window_apply is not None
-    if combined and dispatch.window_apply is None:
+    if (dispatch.window_plan is None) != (dispatch.window_merge is None):
         raise ValueError(
-            f"combined=True but {dispatch.name} has no window_apply"
+            f"{dispatch.name}: window_plan and window_merge come as a "
+            f"pair (got only one)"
+        )
+    has_combined = (
+        dispatch.window_apply is not None
+        or dispatch.window_plan is not None
+    )
+    if combined is None:
+        combined = has_combined
+    if combined and not has_combined:
+        raise ValueError(
+            f"combined=True but {dispatch.name} has no window_apply "
+            f"or window_plan/window_merge"
         )
 
     def step(log, states, wr_opcodes, wr_args, rd_opcodes, rd_args):
@@ -106,9 +121,22 @@ def make_step(
                 spec, log.opcodes, log.args, log.tail - span, log.tail,
                 span,
             )
-            states, resps = jax.vmap(
-                lambda s: dispatch.window_apply(s, opc_w, args_w)
-            )(states)
+            if dispatch.window_plan is not None:
+                # plan/merge split: the sorts+scans run ONCE on a
+                # representative replica (sound by the lock-step
+                # precondition above — states are identical by
+                # induction); the vmapped merge does the per-replica
+                # dense replay work
+                plan = dispatch.window_plan(
+                    jax.tree.map(lambda x: x[0], states), opc_w, args_w
+                )
+                states, resps = jax.vmap(
+                    lambda s: dispatch.window_merge(s, plan)
+                )(states)
+            else:
+                states, resps = jax.vmap(
+                    lambda s: dispatch.window_apply(s, opc_w, args_w)
+                )(states)
             # lock-step cursor bookkeeping (every replica consumed the
             # span): same lattice updates as log_exec_all
             new_ltails = jnp.broadcast_to(log.tail, (R,))

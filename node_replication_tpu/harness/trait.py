@@ -244,12 +244,13 @@ class MultiLogRunner(FleetRunner):
         N = int(np.prod(wr_opc.shape[1:]))  # client writes per step
         if N == 0:  # read-only sweep: no write buckets
             self._build(0)
-            self._w = (
-                jnp.zeros((S, L, 0), jnp.int32),
-                jnp.zeros((S, L, 0, A), jnp.int32),
+            # through the placement hook, so the sharded runner pins
+            # even an empty write stream + the reads to their mesh axes
+            self._place_streams(
+                np.zeros((S, L, 0), np.int32),
+                np.zeros((S, L, 0, A), np.int32),
+                np.zeros((S, L), np.int64), rd_opc, rd_args,
             )
-            self._counts = jnp.zeros((S, L), jnp.int64)
-            self._r = (jax.device_put(rd_opc), jax.device_put(rd_args))
             self.dispatches_per_step = self.n_replicas * self.Br
             self.client_ops_per_step = self.n_replicas * self.Br
             return

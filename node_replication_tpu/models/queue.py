@@ -61,10 +61,91 @@ def make_queue(capacity: int) -> Dispatch:
     def length(state, args):
         return state["tail"] - state["head"]
 
+    def window_apply(state, opcodes, args):
+        """Combined replay for the FIFO (see `Dispatch.window_apply` and
+        the stack's docstring — same decomposition, two cursors).
+
+        The length n = tail - head is the +-1 walk clamped to
+        [0, capacity] (`ops/windowkit.clamped_walk`); each cursor then
+        advances by the EXCLUSIVE count of effective ops of its kind
+        (plain cumsums), which fixes every op's ring slot up front:
+
+        - effective ENQ at tail t writes slot t % capacity (LWW update;
+          resp n+1, full enqueues resp -1),
+        - effective DEQ at head h reads slot h % capacity — the latest
+          earlier in-window enqueue to that slot, else the replica's
+          initial buffer. A later GENERATION (tail = h + capacity) can
+          never overwrite the slot before its dequeue consumes it (that
+          enqueue would need n >= capacity and is dropped), so per-slot
+          last-writer-wins resolution is exact.
+
+        Bit-identical to folding enq/deq in order
+        (tests/test_window.py::TestQueueWindowApply).
+        """
+        plan = window_plan(state, opcodes, args)
+        return window_merge(state, plan)
+
+    def window_plan(state, opcodes, args):
+        """Shared half of the combined replay (see the stack's
+        `window_plan` and `Dispatch.window_plan`)."""
+        from node_replication_tpu.ops.windowkit import (
+            clamped_walk,
+            last_update_table,
+            slot_resolve,
+        )
+
+        is_enq = opcodes == Q_ENQ
+        is_deq = opcodes == Q_DEQ
+        v = args[:, 0]
+        delta = jnp.where(is_enq, 1, jnp.where(is_deq, -1, 0))
+        n0 = state["tail"] - state["head"]
+        before, after = clamped_walk(delta, 0, capacity, n0)
+        eff_enq = is_enq & (before < capacity)
+        eff_deq = is_deq & (before > 0)
+        enq_sum = jnp.cumsum(eff_enq.astype(jnp.int32))
+        deq_sum = jnp.cumsum(eff_deq.astype(jnp.int32))
+        t_before = state["tail"].astype(jnp.int32) + enq_sum - (
+            eff_enq.astype(jnp.int32)
+        )
+        h_before = state["head"].astype(jnp.int32) + deq_sum - (
+            eff_deq.astype(jnp.int32)
+        )
+        slot_upd = jnp.where(eff_enq, t_before % capacity, capacity)
+        slot_qry = jnp.where(eff_deq, h_before % capacity, capacity)
+        dequeued = slot_resolve(slot_upd, v, slot_qry, state["buf"],
+                                capacity)
+        resps = jnp.where(
+            is_enq,
+            jnp.where(eff_enq, before + 1, jnp.int32(EMPTY)),
+            jnp.where(
+                is_deq,
+                jnp.where(eff_deq, dequeued, jnp.int32(EMPTY)),
+                jnp.int32(0),
+            ),
+        ).astype(jnp.int32)
+        touched, lastv = last_update_table(slot_upd, v, capacity)
+        W = opcodes.shape[0]
+        return {
+            "touched": touched, "lastv": lastv, "resps": resps,
+            "enq_total": enq_sum[W - 1] if W > 0 else jnp.int32(0),
+            "deq_total": deq_sum[W - 1] if W > 0 else jnp.int32(0),
+        }
+
+    def window_merge(state, plan):
+        buf = jnp.where(plan["touched"], plan["lastv"], state["buf"])
+        return {
+            "buf": buf,
+            "head": (state["head"] + plan["deq_total"]).astype(jnp.int32),
+            "tail": (state["tail"] + plan["enq_total"]).astype(jnp.int32),
+        }, plan["resps"]
+
     return Dispatch(
         name=f"queue{capacity}",
         make_state=make_state,
         write_ops=(enq, deq),
         read_ops=(front, length),
         arg_width=3,
+        window_apply=window_apply,
+        window_plan=window_plan,
+        window_merge=window_merge,
     )

@@ -49,6 +49,18 @@ class Dispatch:
 
     Hashable (frozen, tuples of functions) so it can be a jit static arg.
 
+    `window_plan` / `window_merge` (optional, come as a pair) split the
+    combined replay for models whose window algebra DEPENDS on the
+    running state (stack, queue: every slot assignment needs the clamped
+    depth walk from the initial top): `window_plan(state, opcodes, args)
+    -> plan` runs ONCE per window on a representative replica — this is
+    where the sorts and scans live — and `window_merge(state, plan) ->
+    (state, resps)` applies the plan's dense result per replica
+    (elementwise, the honest per-replica replay work). Sound under the
+    fused step's lock-step precondition (all replicas identical by
+    induction); divergent-state replay must use the scan path, exactly
+    as it already must for cursor catch-up.
+
     `window_apply` (optional) is the *combined replay* fast path:
     `(state, opcodes[W], args[W, A]) -> (state, resps[W])`, bit-identical
     to folding `apply_write` over the window in order. Models whose write
@@ -69,6 +81,8 @@ class Dispatch:
     read_ops: tuple
     arg_width: int = 3
     window_apply: Callable | None = None
+    window_plan: Callable | None = None
+    window_merge: Callable | None = None
 
     @property
     def n_write_ops(self) -> int:

@@ -1,0 +1,120 @@
+"""Shared building blocks for combined window replay (`window_apply`).
+
+The order-dependent models (stack, queue) looked scan-bound — every op's
+effect depends on the running depth — but decompose into two parallel
+passes the LWW models don't need:
+
+1. `clamped_walk`: the depth/length before every op. Push/pop (enq/deq)
+   move a counter by ±1 CLAMPED to [0, capacity] — a fold of functions
+   `x -> min(max(x + a, lo), hi)`, a family CLOSED under composition, so
+   the whole window collapses to one `associative_scan` over (a, lo, hi)
+   triples (the min-plus cousin of memfs's max-affine size scan).
+2. `slot_resolve`: once depths are known, every effective push/enq is a
+   last-writer-wins UPDATE of a known slot and every effective pop/deq
+   is a QUERY of a known slot — one stable sort by slot + one segmented
+   rightmost-non-identity scan answers all queries against strictly
+   earlier updates (the same machinery as the vspace radix region
+   stream), and the buffer never needs per-entry replay at all (pops
+   don't clear `buf` in these models; slots are only overwritten).
+
+All helpers are jit-safe and fixed-shape. The walk origin and the query
+fallback depend on replica state, so models package these passes as
+`Dispatch.window_plan` (run once per window on a representative replica
+— a per-replica vmap of the sort would batch R sorts and dominates the
+step at fleet scale) and keep the plain `window_apply` form for
+arbitrary-state use.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def clamped_walk(delta, lo: int, hi: int, x0):
+    """Value of the clamped counter BEFORE and AFTER each op.
+
+    `delta int[W]` (+1/-1/0), bounds [lo, hi] applied at every step:
+    `x_{t+1} = min(max(x_t + delta_t, lo), hi)`. Returns
+    `(before int[W], after int[W])` for origin `x0` (a scalar; may be a
+    traced per-replica value — the scan itself is origin-independent).
+    """
+    d = delta.astype(jnp.int32)
+    a = d
+    l_el = jnp.full_like(d, lo)
+    h_el = jnp.full_like(d, hi)
+
+    def compose(f, g):
+        # f then g over x -> min(max(x+a, l), h)
+        af, lf, hf = f
+        ag, lg, hg = g
+        return (
+            af + ag,
+            jnp.minimum(jnp.maximum(lf + ag, lg), hg),
+            jnp.minimum(jnp.maximum(hf + ag, lg), hg),
+        )
+
+    pa, pl, ph = jax.lax.associative_scan(compose, (a, l_el, h_el))
+    x0 = jnp.asarray(x0, jnp.int32)
+    after = jnp.minimum(jnp.maximum(x0 + pa, pl), ph)
+    before = jnp.concatenate([x0[None], after[:-1]])
+    return before, after
+
+
+def slot_resolve(slot_upd, upd_val, slot_qry, init_vals, n_slots: int):
+    """Answer every query with the latest earlier update to its slot.
+
+    Per window position t, AT MOST one of update/query is active
+    (`slot_upd[t]`/`slot_qry[t]` in [0, n_slots), or the `n_slots`
+    sentinel when inactive). Returns `resp int[W]` where active queries
+    get the value of the last active update to their slot at an earlier
+    position, falling back to `init_vals[slot]`; inactive positions get
+    `init_vals` garbage that callers must mask.
+    """
+    W = slot_upd.shape[0]
+    is_upd = slot_upd < n_slots
+    is_qry = slot_qry < n_slots
+    key = jnp.where(is_upd, slot_upd, slot_qry).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    sk = key[order]
+    segf = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sk[1:] != sk[:-1]]
+    )
+
+    def seg_last(a, b):
+        va, ha, fa = a
+        vb, hb, fb = b
+        keep_b = fb | hb
+        return (
+            jnp.where(keep_b, vb, va),
+            jnp.where(fb, hb, ha | hb),
+            fa | fb,
+        )
+
+    pv, ph, _ = jax.lax.associative_scan(
+        seg_last, (upd_val[order], is_upd[order], segf)
+    )
+    # a query position is the identity element, so its inclusive scan
+    # value covers exactly the strictly-earlier updates of its segment
+    init_q = init_vals.at[
+        jnp.minimum(sk, n_slots - 1).astype(jnp.int32)
+    ].get(mode="clip")
+    resolved_s = jnp.where(ph & ~is_upd[order], pv, init_q)
+    return jnp.zeros((W,), init_vals.dtype).at[order].set(resolved_s)
+
+
+def last_update_table(slot_upd, upd_val, n_slots: int):
+    """Per-slot last active update as a dense `(touched bool[n_slots],
+    value int32[n_slots])` pair — the SHARED half of the final-state
+    merge; callers blend `where(touched, value, buf)` per replica
+    (`slot_upd` uses the `n_slots` sentinel for inactive). int32
+    throughout: at int64 a big capacity doubles the scatter buffer.
+    """
+    W = slot_upd.shape[0]
+    last = (
+        jnp.full((n_slots + 1,), -1, jnp.int32)
+        .at[slot_upd.astype(jnp.int32)]
+        .max(jnp.arange(W, dtype=jnp.int32))[:n_slots]
+    )
+    li = jnp.clip(last, 0).astype(jnp.int32)
+    return last >= 0, upd_val[li]
