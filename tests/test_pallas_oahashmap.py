@@ -1,0 +1,164 @@
+"""Open-addressing hashmap Pallas kernel tests (interpret mode on CPU).
+
+Differential contract: probe-window first-match/first-free selection,
+tombstone transitions, wrapped windows, and window-full drops must agree
+BIT-identically with the sequential `apply_write` fold. `NR_TPU_SMOKE=1`
+runs the Mosaic lowering on real hardware.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from node_replication_tpu.core.log import LogSpec, log_init
+from node_replication_tpu.core.replica import replicate_state
+from node_replication_tpu.core.step import make_step
+from node_replication_tpu.models import make_oahashmap
+from node_replication_tpu.ops.encoding import apply_write
+from node_replication_tpu.ops.pallas_oahashmap import (
+    make_oahashmap_replay,
+    make_pallas_oahashmap_step,
+    oahashmap_model_view,
+    pallas_oahashmap_state,
+)
+
+
+def fold(d, state, opcodes, args):
+    step = jax.jit(lambda s, o, a: apply_write(d, s, o, a))
+    resps = []
+    for i in range(len(opcodes)):
+        state, r = step(state, opcodes[i], args[i])
+        resps.append(int(r))
+    return state, resps
+
+
+class TestOaKernel:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_sequential_fold(self, seed):
+        # small table + tiny keyspace: heavy window collisions, wraps,
+        # tombstone churn, and window-full drops all occur
+        S_TAB, PROBE, W, R = 300, 8, 96, 3
+        d = make_oahashmap(S_TAB, probe=PROBE)
+        rng = np.random.default_rng(seed)
+        opcodes = jnp.asarray(
+            rng.choice([0, 1, 2, 9], size=W, p=[0.06, 0.55, 0.33, 0.06]),
+            jnp.int32,
+        )
+        args = jnp.asarray(
+            np.stack([rng.integers(-50, 50, W), rng.integers(1, 999, W),
+                      np.zeros(W)], axis=1),
+            jnp.int32,
+        )
+        st0 = d.init_state()
+        ref_state, ref_resps = fold(d, st0, opcodes, args)
+        replay = make_oahashmap_replay(S_TAB, PROBE, R, W,
+                                       interpret=True)
+        st = pallas_oahashmap_state(S_TAB, R, st0)
+        keys, vals, flag, resps = replay(
+            opcodes, args, st["keys"], st["vals"], st["flag"]
+        )
+        assert [int(x) for x in resps] == ref_resps
+        view = oahashmap_model_view(
+            {"keys": keys, "vals": vals, "flag": flag}, S_TAB
+        )
+        for k in ("keys", "vals", "flag"):
+            for r in range(R):
+                np.testing.assert_array_equal(
+                    np.asarray(view[k][r]), np.asarray(ref_state[k]), k
+                )
+
+    def test_step_matches_scan_step(self):
+        S_TAB, PROBE, R, Bw, Br, STEPS = 300, 8, 3, 4, 2, 4
+        d = make_oahashmap(S_TAB, probe=PROBE)
+        spec = LogSpec(capacity=1 << 10, n_replicas=R, gc_slack=32)
+        rng = np.random.default_rng(5)
+        scan_step = make_step(d, spec, Bw, Br, jit=False, combined=False)
+        pl_step = make_pallas_oahashmap_step(
+            S_TAB, PROBE, spec, Bw, Br, interpret=True, jit=False
+        )
+        log_a, st_a = log_init(spec), replicate_state(d.init_state(), R)
+        log_b = log_init(spec)
+        st_b = pallas_oahashmap_state(S_TAB, R, d.init_state())
+        for _ in range(STEPS):
+            wr_opc = jnp.asarray(
+                rng.choice([0, 1, 2], size=(R, Bw)), jnp.int32
+            )
+            wr_args = jnp.asarray(
+                np.stack([rng.integers(-30, 30, (R, Bw)),
+                          rng.integers(1, 99, (R, Bw)),
+                          np.zeros((R, Bw))], axis=-1),
+                jnp.int32,
+            )
+            rd_opc = jnp.ones((R, Br), jnp.int32)
+            rd_args = jnp.asarray(
+                np.stack([rng.integers(-30, 30, (R, Br)),
+                          np.zeros((R, Br)), np.zeros((R, Br))],
+                         axis=-1),
+                jnp.int32,
+            )
+            log_a, st_a, wr_a, rd_a = scan_step(
+                log_a, st_a, wr_opc, wr_args, rd_opc, rd_args
+            )
+            log_b, st_b, wr_b, rd_b = pl_step(
+                log_b, st_b, wr_opc, wr_args, rd_opc, rd_args
+            )
+            np.testing.assert_array_equal(np.asarray(wr_a), np.asarray(wr_b))
+            np.testing.assert_array_equal(np.asarray(rd_a), np.asarray(rd_b))
+        view = oahashmap_model_view(st_b, S_TAB)
+        for k in ("keys", "vals", "flag"):
+            np.testing.assert_array_equal(
+                np.asarray(view[k]), np.asarray(st_a[k]), k
+            )
+
+
+@pytest.mark.skipif(
+    not os.environ.get("NR_TPU_SMOKE"),
+    reason="hardware smoke (set NR_TPU_SMOKE=1 on a real TPU)",
+)
+class TestHardwareSmoke:
+    def test_oa_kernel_on_device(self):
+        import subprocess
+        import sys
+
+        code = """
+import numpy as np, jax, jax.numpy as jnp
+assert jax.devices()[0].platform != "cpu", jax.devices()
+from node_replication_tpu.models import make_oahashmap
+from node_replication_tpu.ops.encoding import apply_write
+from node_replication_tpu.ops.pallas_oahashmap import (
+    make_oahashmap_replay, pallas_oahashmap_state, oahashmap_model_view)
+S_TAB, PROBE, W, R = 4096, 16, 256, 4
+d = make_oahashmap(S_TAB, probe=PROBE)
+rng = np.random.default_rng(0)
+opc = jnp.asarray(rng.choice([1, 2], size=W, p=[0.7, 0.3]), jnp.int32)
+args = jnp.asarray(np.stack([rng.integers(-500, 500, W),
+    rng.integers(1, 999, W), np.zeros(W)], axis=1), jnp.int32)
+st0 = d.init_state()
+step = jax.jit(lambda s, o, a: apply_write(d, s, o, a))
+ref, rresp = st0, []
+for i in range(W):
+    ref, r = step(ref, opc[i], args[i])
+    rresp.append(int(r))
+replay = jax.jit(make_oahashmap_replay(S_TAB, PROBE, R, W))
+st = pallas_oahashmap_state(S_TAB, R, st0)
+keys, vals, flag, resps = replay(opc, args, st["keys"], st["vals"],
+                                 st["flag"])
+assert [int(x) for x in np.asarray(resps)] == rresp
+view = oahashmap_model_view({"keys": keys, "vals": vals, "flag": flag},
+                            S_TAB)
+for k in ("keys", "vals", "flag"):
+    for r in range(R):
+        np.testing.assert_array_equal(
+            np.asarray(view[k][r]), np.asarray(ref[k]), k)
+print("oahashmap-pallas-on-tpu OK", jax.devices()[0].device_kind)
+"""
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=560, cwd="/root/repo",
+        )
+        assert "oahashmap-pallas-on-tpu OK" in out.stdout, (
+            out.stdout + out.stderr
+        )
