@@ -51,7 +51,6 @@ def main():
         return
 
     # ---- headline: in-engine C++ measurement loops -------------------
-    import csv
     import os
 
     n_req = args.readers + args.writers
@@ -67,7 +66,8 @@ def main():
 
     def record(system, total, per, threads):
         # write ratio rides the row name so committed CSV blocks are
-        # self-describing (r4 review)
+        # self-describing (r4 review); every loop here flips a per-op
+        # coin, so the effective ratio equals the nominal one
         system = f"{system}-wr{write_pct}"
         mops = total / args.duration / 1e6
         print(f">> hashbench/{system} t={threads} "
@@ -80,7 +80,7 @@ def main():
                 "tm": "none", "batch": 32, "threads": threads,
                 "duration": args.duration, "thread_id": t,
                 "core_id": t, "second": -1, "ops": int(ops),
-                "dispatches": int(ops),
+                "dispatches": int(ops), "wr_eff": write_pct,
             })
 
     e = NativeEngine(MODEL_HASHMAP, keys, n_replicas=R,
@@ -99,14 +99,14 @@ def main():
                 system, n_threads, write_pct, keys, duration_ms=dur_ms
             )
             record(system, total, per, len(per))
-    os.makedirs(args.out_dir, exist_ok=True)
-    path = os.path.join(args.out_dir, "scaleout_benchmarks.csv")
-    fresh = not os.path.exists(path)
-    with open(path, "a", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
-        if fresh:
-            w.writeheader()
-        w.writerows(rows)
+    from node_replication_tpu.harness.mkbench import (
+        _append_csv,
+        _CSV_FIELDS,
+        SCALEOUT_CSV,
+    )
+
+    _append_csv(os.path.join(args.out_dir, SCALEOUT_CSV), _CSV_FIELDS,
+                rows)
 
 
 def ffi_smoke(args, keys, R):

@@ -55,6 +55,7 @@ def main():
         SCALEOUT_CSV,
         _append_csv,
         _CSV_FIELDS,
+        effective_write_pct,
         measure_step_runner,
         sweep_rows,
     )
@@ -120,7 +121,10 @@ def main():
                   f"({res.mops:.2f} Mops replayed, pages touched "
                   f"<={args.span}/op)")
             cfg = name + ("-longlog" if args.long_log else "")
-            rows.extend(sweep_rows(cfg, runner.name, res, R, 1, batch))
+            rows.extend(sweep_rows(
+                cfg, runner.name, res, R, 1, batch,
+                wr_eff=effective_write_pct(batch, 1),
+            ))
     _append_csv(os.path.join(args.out_dir, SCALEOUT_CSV), _CSV_FIELDS,
                 rows)
 

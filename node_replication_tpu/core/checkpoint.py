@@ -33,7 +33,7 @@ import numpy as np
 from node_replication_tpu.core.log import (
     LogSpec,
     LogState,
-    log_exec_all,
+    log_catchup_all,
 )
 from node_replication_tpu.core.replica import replicate_state
 from node_replication_tpu.ops.encoding import Dispatch
@@ -132,8 +132,12 @@ def recover_states(
     log = log._replace(
         ltails=jnp.full((spec.n_replicas,), start, jnp.int64)
     )
+    # Combined catch-up (`log_catchup_all`): recovery replays at
+    # window_apply speed when the model provides it, scan otherwise —
+    # the reference recovers through the same hot exec loop it always
+    # runs (`nr/src/log.rs:473-524`), and so does this.
     exec_jit = jax.jit(
-        lambda lg, st: log_exec_all(spec, dispatch, lg, st, window)
+        lambda lg, st: log_catchup_all(spec, dispatch, lg, st, window)
     )
     states = base_states
     while int(jnp.min(log.ltails)) < int(log.tail):

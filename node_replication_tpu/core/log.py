@@ -262,6 +262,70 @@ def log_exec_all(
     return log, states, resps
 
 
+def log_catchup_all(
+    spec: LogSpec,
+    d: Dispatch,
+    log: LogState,
+    states: PyTree,
+    window: int,
+    limits: jax.Array | None = None,
+):
+    """Combined catch-up: `log_exec_all` semantics at `window_apply` speed.
+
+    In the reference, catch-up IS the hot loop — a lagging replica replays
+    through the same `exec` everyone uses (`nr/src/log.rs:473-524`). The
+    fused step's plan/merge split can't serve that role here (it needs the
+    lock-step precondition, `core/step.py`), but `window_apply` works on
+    ARBITRARY per-replica state: each replica gathers its own
+    `[ltails[r], min(tail, ltails[r]+window))` window from the ring
+    (positions past its effective tail masked to NOOP by `gather_window`)
+    and applies it as one combined reduction instead of a `window`-long
+    sequential scan. Same cursor lattice updates, same response layout
+    (`resps[r, i]` answers position `old_ltails[r] + i`), bit-identical
+    states — differentially tested in `tests/test_window.py`.
+
+    Falls back to `log_exec_all` when the model has no `window_apply`
+    (plan/merge-only models use their `window_apply` form, which all
+    bundled models provide alongside the split).
+    """
+    if d.window_apply is None:
+        return log_exec_all(spec, d, log, states, window, limits)
+
+    def one(state, ltail, limit=None):
+        eff_tail = (
+            log.tail if limit is None else jnp.minimum(log.tail, limit)
+        )
+        check(ltail <= log.tail,
+              "replica ltail {lt} ahead of log tail {t}",
+              lt=ltail, t=log.tail)
+        check(ltail >= log.head,
+              "catch-up window starts at {lt}, behind GC head {h}: "
+              "entries already overwritten",
+              lt=ltail, h=log.head)
+        opcodes, args = gather_window(
+            spec, log.opcodes, log.args, ltail, eff_tail, window
+        )
+        state, resps = d.window_apply(state, opcodes, args)
+        new_ltail = jnp.minimum(ltail + window, eff_tail)
+        new_ltail = jnp.maximum(new_ltail, ltail)  # limit below ltail
+        return state, resps, new_ltail
+
+    if limits is None:
+        states, resps, new_ltails = jax.vmap(
+            lambda s, lt: one(s, lt)
+        )(states, log.ltails)
+    else:
+        states, resps, new_ltails = jax.vmap(one)(
+            states, log.ltails, jnp.asarray(limits, jnp.int64)
+        )
+    log = log._replace(
+        ltails=new_ltails,
+        ctail=jnp.maximum(log.ctail, jnp.max(new_ltails)),
+        head=jnp.min(new_ltails),
+    )
+    return log, states, resps
+
+
 def is_replica_synced_for_reads(
     log: LogState, ridx: int, ctail: jax.Array
 ) -> jax.Array:

@@ -33,6 +33,7 @@ path is `core/step.py`.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from collections import deque
 from functools import partial
@@ -46,6 +47,7 @@ from node_replication_tpu.core.log import (
     LogSpec,
     WARN_ROUNDS,
     log_append,
+    log_catchup_all,
     log_exec_all,
     log_init,
     log_space,
@@ -124,6 +126,7 @@ class NodeReplicated:
         exec_window: int = DEFAULT_EXEC_WINDOW,
         gc_callback: Callable[[int, int], None] | None = None,
         debug: bool | None = None,
+        engine: str = "auto",
     ):
         kw = {}
         if log_entries is not None:
@@ -156,11 +159,40 @@ class NodeReplicated:
         self._inflight: list[deque] = [deque() for _ in range(n_replicas)]
         self._exec_rounds = 0
 
+        # Replay engine for every cursor catch-up loop (sync, read-sync,
+        # combine-replay, recovery): 'combined' routes through
+        # `log_catchup_all` — per-replica `window_apply` on arbitrary
+        # divergent state, the reference's catch-up-at-hot-loop-speed
+        # contract (`nr/src/log.rs:473-524`) — 'scan' forces the generic
+        # vmapped scan, 'auto' (default) picks combined when the model
+        # provides `window_apply`.
+        if engine not in ("auto", "combined", "scan"):
+            raise ValueError(f"unknown engine {engine!r}")
+        if engine == "combined" and dispatch.window_apply is None:
+            raise ValueError(
+                f"engine='combined' but {dispatch.name} has no window_apply"
+            )
+        use_combined = (
+            dispatch.window_apply is not None
+            if engine == "auto"
+            else engine == "combined"
+        )
+        self.engine = "combined" if use_combined else "scan"
+        self._build_jits()
+
+    def _build_jits(self) -> None:
+        """(Re)build the compiled append/exec/read entry points against the
+        CURRENT `self.spec` — called from `__init__` and `grow_fleet`
+        (growing changes `n_replicas`, so the partials must rebind)."""
+        dispatch = self.dispatch
+        exec_fn = (
+            log_catchup_all if self.engine == "combined" else log_exec_all
+        )
         if self.debug:
             from node_replication_tpu.utils.checks import checked
 
             self._exec_jit = jax.jit(
-                checked(partial(log_exec_all, self.spec, dispatch)),
+                checked(partial(exec_fn, self.spec, dispatch)),
                 static_argnames=("window",),
             )
             self._append_jit = jax.jit(
@@ -168,7 +200,7 @@ class NodeReplicated:
             )
         else:
             self._exec_jit = jax.jit(
-                partial(log_exec_all, self.spec, dispatch),
+                partial(exec_fn, self.spec, dispatch),
                 static_argnames=("window",),
                 donate_argnums=(0, 1),
             )
@@ -201,6 +233,66 @@ class NodeReplicated:
         self._threads_per_replica[rid] = tid + 1
         self._contexts[(rid, tid)] = Context()
         return ReplicaToken(rid, tid)
+
+    def grow_fleet(self, k: int = 1, donor: int | None = None,
+                   catch_up: bool = True) -> list[int]:
+        """Dynamic replica registration: add `k` replicas to a LIVE
+        instance and return their new rids.
+
+        The reference registers replicas against a live log at any time —
+        `Log::register` CASes a fresh id (`nr/src/log.rs:272-292`) and
+        `Replica::new` calls it at construction
+        (`nr/src/replica.rs:184-232`); the newcomer starts from `Default`
+        at position 0, which is only sound before the ring wraps. Here
+        the newcomer instead CLONES the most caught-up replica's state —
+        a consistent snapshot at exactly `ltails[donor]` (induction: a
+        replica's state is the fold of `[0, ltails[r])`) — inherits that
+        cursor, and catches up through the same combined/scan exec loop
+        every replica uses (`log_catchup_all`), so a join is valid at ANY
+        point in the log's lifetime, wraps included. Existing tokens stay
+        valid (rids are stable); register threads on the new rids to use
+        them. GC can only speed up: the newcomer's ltail is the max, so
+        `head = min(ltails)` is unchanged.
+        """
+        if k < 1:
+            raise ValueError("grow_fleet needs k >= 1")
+        R = self.n_replicas
+        ltails = np.asarray(self.log.ltails)
+        if donor is None:
+            donor = int(np.argmax(ltails))
+        elif not 0 <= donor < R:
+            raise ValueError(f"donor replica {donor} out of range")
+        donor_ltail = int(ltails[donor])
+
+        self.spec = dataclasses.replace(
+            self.spec, n_replicas=R + k
+        )
+        # states: stack k bit-copies of the donor's snapshot onto the
+        # replica axis; cursors: the newcomers start at the donor's ltail
+        self.states = jax.tree.map(
+            lambda x: jnp.concatenate(
+                [x] + [x[donor][None]] * k, axis=0
+            ),
+            self.states,
+        )
+        self.log = self.log._replace(
+            ltails=jnp.concatenate(
+                [self.log.ltails,
+                 jnp.full((k,), donor_ltail, jnp.int64)]
+            )
+        )
+        self._threads_per_replica.extend([0] * k)
+        self._inflight.extend(deque() for _ in range(k))
+        self._build_jits()
+        new_rids = list(range(R, R + k))
+        get_tracer().emit(
+            "grow_fleet", k=k, donor=donor, donor_ltail=donor_ltail,
+            n_replicas=R + k,
+        )
+        if catch_up:
+            for rid in new_rids:
+                self.sync(rid)
+        return new_rids
 
     def execute_mut(self, op: tuple, token: ReplicaToken):
         """Stage one write op, combine, and return its response

@@ -51,6 +51,7 @@ def make_step(
     jit: bool = True,
     donate: bool = True,
     combined: bool | None = None,
+    check_lockstep: bool | None = None,
 ):
     """Build `step(log, states, wr_opcodes, wr_args, rd_opcodes, rd_args)`.
 
@@ -72,6 +73,16 @@ def make_step(
     False = the generic vmapped scan, None (default) = combined when the
     model provides it. Both read the window back from the ring, so the
     log remains the source of truth either way.
+
+    `check_lockstep` guards the combined engines' precondition at
+    runtime: when True (or env NR_TPU_CHECK_LOCKSTEP=1 with the default
+    None), a combined step verifies cursors are synced on entry — both
+    combined branches replay only the appended span and then force
+    `ltails = tail` — and the plan/merge split additionally verifies
+    every replica's state bit-equals replica 0's before imposing
+    replica-0's plan; violations RAISE (via checkify) instead of
+    silently corrupting state. Costs one checkify wrap + an R-way
+    equality reduce per step; off by default for the hot path.
     """
     R = spec.n_replicas
     Bw = int(writes_per_replica)
@@ -99,8 +110,34 @@ def make_step(
             f"combined=True but {dispatch.name} has no window_apply "
             f"or window_plan/window_merge"
         )
+    if check_lockstep is None:
+        import os
+
+        check_lockstep = os.environ.get("NR_TPU_CHECK_LOCKSTEP", "") == "1"
+    # both combined branches replay only the just-appended span and then
+    # force ltails = tail, so BOTH require synced cursors on entry; the
+    # plan/merge split additionally imposes replica-0's plan, so it also
+    # requires bit-identical states
+    guard_combined = bool(check_lockstep and combined)
+    guard_plan = guard_combined and dispatch.window_plan is not None
+    if guard_combined:
+        from jax.experimental import checkify
 
     def step(log, states, wr_opcodes, wr_args, rd_opcodes, rd_args):
+        if guard_combined:
+            ok = jnp.all(log.ltails == log.tail)
+            msg = ("combined step requires synced cursors "
+                   "(ltails == tail)")
+            if guard_plan:
+                for leaf in jax.tree.leaves(states):
+                    ok = ok & jnp.all(leaf == leaf[:1])
+                msg = ("plan/merge fast path requires a lock-step fleet "
+                       "(synced cursors + identical replica states)")
+            checkify.check(
+                ok,
+                msg + "; use combined=False or NodeReplicated catch-up "
+                "for divergent fleets",
+            )
         # 1-2. replica-major concatenation + one batched append.
         log = log_append(
             spec,
@@ -156,6 +193,17 @@ def make_step(
         rd_resps = dispatch_reads(dispatch, states, rd_opcodes, rd_args)
         return log, states, wr_resps, rd_resps
 
+    if guard_combined:
+        inner = checkify.checkify(step)
+        if jit:
+            inner = jax.jit(inner, donate_argnums=(0, 1) if donate else ())
+
+        def checked_step(*args):
+            err, out = inner(*args)
+            err.throw()
+            return out
+
+        return checked_step
     if jit:
         step = jax.jit(step, donate_argnums=(0, 1) if donate else ())
     return step

@@ -65,17 +65,41 @@ BASELINE_CSV = "baseline_comparison.csv"
 # real (thread, core) ids from the engine's in-loop bins.
 _CSV_FIELDS = [
     "name", "rs", "ls", "tm", "batch", "threads", "duration",
-    "thread_id", "core_id", "second", "ops", "dispatches",
+    "thread_id", "core_id", "second", "ops", "dispatches", "wr_eff",
 ]
+# `wr_eff` (r5; VERDICT r2→r4 carryover): the EFFECTIVE write percentage
+# a swept row actually ran, computed from the static (Bw, Br) shape that
+# `split_write_read` realized — rounding makes wr=10 at batch 32 really
+# 9.4% and at batch 4 really 25%, and the row name's nominal ratio hid
+# that. Native rows flip a per-op coin (`nr_bench_hashmap`), so their
+# effective ratio IS the nominal one.
 
 
 def _append_csv(path: str, fields: list[str], rows: list[dict]) -> None:
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
+    if os.path.exists(path):
+        # schema upgrade: when an existing CSV predates newly added
+        # columns (e.g. wr_eff), rewrite it once — old rows keep "" in
+        # the new columns, so historical measurements stay valid
+        with open(path, newline="") as f:
+            r = csv.reader(f)
+            header = next(r, None)
+            if header is not None and header != fields and set(
+                header
+            ) < set(fields):
+                old_rows = [dict(zip(header, row)) for row in r]
+                tmp = f"{path}.{os.getpid()}.tmp"
+                with open(tmp, "w", newline="") as g:
+                    w = csv.DictWriter(g, fieldnames=fields,
+                                       restval="")
+                    w.writeheader()
+                    w.writerows(old_rows)
+                os.replace(tmp, path)
     fresh = not os.path.exists(path)
     with open(path, "a", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=fields)
+        w = csv.DictWriter(f, fieldnames=fields, restval="")
         if fresh:
             w.writeheader()
         w.writerows(rows)
@@ -209,6 +233,7 @@ def baseline_comparison(
                     "second": -1,
                     "ops": res.total_client_ops,
                     "dispatches": res.total_dispatches,
+                    "wr_eff": effective_write_pct(bw, br),
                 }
             )
             print(f">> {res.name} batch={batch}: "
@@ -427,6 +452,7 @@ class ScaleBenchBuilder:
                             self.name, runner.name, res, R, nlogs, batch,
                             tm=(strat.value if strat is not None
                                 else "none"),
+                            wr_eff=effective_write_pct(bw, br),
                         ))
         _append_csv(
             os.path.join(self._out_dir, SCALEOUT_CSV), _CSV_FIELDS, rows
@@ -439,9 +465,17 @@ class ScaleBenchBuilder:
         return results
 
 
+def effective_write_pct(bw: int, br: int) -> float:
+    """The write percentage the static (Bw, Br) split actually realizes
+    (`split_write_read` rounds; this records what ran — the `wr_eff`
+    column's single source of truth)."""
+    total = bw + br
+    return round(100.0 * bw / total, 2) if total else 0.0
+
+
 def sweep_rows(
     name: str, runner_name: str, res, rs: int, ls: int, batch: int,
-    tm: str = "none",
+    tm: str = "none", wr_eff: float | str = "",
 ) -> list[dict]:
     """Per-second CSV rows for one measured step-runner config — the
     shared row shape of SCALEOUT_CSV (used by the ScaleBenchBuilder
@@ -455,6 +489,7 @@ def sweep_rows(
             "threads": rs, "duration": round(res.duration_s, 3),
             "thread_id": -1, "core_id": -1, "second": sec,
             "ops": ops, "dispatches": int(ops * disp_frac),
+            "wr_eff": wr_eff,
         }
         for sec, ops in res.per_second
     ]
@@ -483,9 +518,12 @@ def measure_native(
 
 
 def native_rows(
-    runner: NativeRunner, res: MeasureResult, name: str, batch: int
+    runner: NativeRunner, res: MeasureResult, name: str, batch: int,
+    wr_eff: float | str = "",
 ) -> list[dict]:
-    """Per-(thread, second) CSV rows from the native engine's real bins."""
+    """Per-(thread, second) CSV rows from the native engine's real bins.
+    Native loops flip a per-op coin, so their effective write ratio IS
+    the nominal one — callers pass it through as `wr_eff`."""
     per_sec = runner.last_per_sec
     rows = []
     n_threads, n_secs = per_sec.shape
@@ -505,6 +543,7 @@ def native_rows(
                     "second": s,
                     "ops": int(per_sec[t, s]),
                     "dispatches": int(per_sec[t, s]),
+                    "wr_eff": wr_eff,
                 }
             )
     return rows

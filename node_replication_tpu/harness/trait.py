@@ -106,6 +106,7 @@ class ReplicatedRunner(FleetRunner):
         # make_engine=False: a subclass brings its own step + states
         # (e.g. the pallas vspace runner) — skip building the default
         # engine and the replicated model state it would allocate
+        self._combined = combined
         if make_engine:
             self.step = make_step(dispatch, self.spec, self.Bw, self.Br,
                                   combined=combined)
@@ -122,6 +123,52 @@ class ReplicatedRunner(FleetRunner):
         self.track_resp = track_resp
         self._tracked = jnp.zeros((), jnp.int64)
         self._writes_seen = 0
+
+    def grow(self, k: int = 1) -> None:
+        """Dynamic replica registration under the harness
+        (`Log::register`, `nr/src/log.rs:272-292`): widen a LIVE runner by
+        `k` replicas between steps. The runner fleet is lock-step by
+        construction (every step leaves `ltails == tail` and identical
+        states), so the newcomers are bit-copies of replica 0 at the
+        current cursor — no catch-up needed, exactly the degenerate case
+        of `NodeReplicated.grow_fleet`'s donor-snapshot join. The step is
+        rebuilt for the wider fleet; call `prepare()` again with
+        `[S, R+k, ...]` batches before the next `run_step`.
+        """
+        import dataclasses
+
+        if k < 1:
+            raise ValueError("grow needs k >= 1")
+        if type(self) is not ReplicatedRunner:
+            # subclasses bring their own step (sharded jit, pallas
+            # kernel); rebuilding the generic one here would silently
+            # drop their engine — they must override grow themselves
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support grow()"
+            )
+        # validate + build the wider step FIRST: if the new span doesn't
+        # fit the log, make_step raises before any runner state mutates
+        # (a caller catching the error keeps a consistent runner)
+        new_R = self.n_replicas + k
+        new_spec = dataclasses.replace(self.spec, n_replicas=new_R)
+        new_step = make_step(self.dispatch, new_spec, self.Bw, self.Br,
+                             combined=self._combined)
+        self.states = jax.tree.map(
+            lambda x: jnp.concatenate([x] + [x[:1]] * k, axis=0),
+            self.states,
+        )
+        self.log = self.log._replace(
+            ltails=jnp.concatenate(
+                [self.log.ltails,
+                 jnp.broadcast_to(self.log.tail[None], (k,))]
+            )
+        )
+        self.n_replicas = new_R
+        self.spec = new_spec
+        self.step = new_step
+        span = new_R * self.Bw
+        self.dispatches_per_step = new_R * span + new_R * self.Br
+        self.client_ops_per_step = span + new_R * self.Br
 
     def prepare(self, wr_opc, wr_args, rd_opc, rd_args):
         self._w = (jax.device_put(wr_opc), jax.device_put(wr_args))
@@ -530,8 +577,9 @@ class ShardedCnrRunner(MultiLogRunner):
     write buckets live in their own mesh column (the per-log append and
     replay run WITHOUT cross-log traffic), replica states shard over the
     'replica' axis, and XLA places the collectives that join them. The
-    configuration `__graft_entry__.dryrun_multichip` path C proves
-    correct on the virtual mesh is hereby drivable from
+    configuration `tests/test_mesh.py` proves correct on the virtual
+    8-device mesh (multi-log sharding + sharding-is-real assertions) is
+    hereby drivable from
     `ScaleBenchBuilder` (`systems(["sharded-cnr"])`): on an L-chip mesh
     each combiner owns a chip; on one real chip it degrades to a 1x1
     mesh (same program, GSPMD inserts nothing) so the sweep stays

@@ -94,7 +94,7 @@ def make_vspace(n_pages: int, max_span: int = 16) -> Dispatch:
                       False)
         ).astype(jnp.int32)
 
-    def window_apply(state, opcodes, args):
+    def window_plan(state, opcodes, args):
         """Combined replay for the flat vspace (see `Dispatch.window_apply`).
 
         Map/Unmap are last-writer-wins *per page*; what makes vspace more
@@ -114,6 +114,14 @@ def make_vspace(n_pages: int, max_span: int = 16) -> Dispatch:
         (tests/test_window.py::TestVSpaceWindowApply). Replaces the
         sequential replay loop (`nr/src/log.rs:473-524`) with O(E log E)
         parallel work, E = W * max_span.
+
+        Packaged as plan/merge (r5): the sorts and scans — the whole
+        O(E log E) half — depend on the window plus the representative
+        state, so under the fused step they run ONCE per window; the
+        vmapped `window_merge` is the honest per-replica dense blend
+        (one [P]-wide select against the replica's own frames). This is
+        what makes long-log vspace throughput scale linearly with R
+        instead of paying R sorts (the r4 bottleneck).
         """
         W = opcodes.shape[0]
         S = max_span
@@ -171,9 +179,21 @@ def make_vspace(n_pages: int, max_span: int = 16) -> Dispatch:
             .at[pe].max(jnp.arange(E, dtype=jnp.int64))[:n_pages]
         )
         li = jnp.clip(last, 0).astype(jnp.int32)
-        frames = jnp.where(last >= 0, se[li], state["frames"])
-        return {"frames": frames}, resps
+        return {"touched": last >= 0, "value": se[li], "resps": resps}
 
+    def window_merge(state, plan):
+        return {
+            "frames": jnp.where(plan["touched"], plan["value"],
+                                state["frames"])
+        }, plan["resps"]
+
+    def window_apply(state, opcodes, args):
+        # arbitrary-state form (catch-up, divergent fleets): the plan's
+        # presence-before/response half reads THIS state, so the
+        # composition is the full sequential-fold semantics per replica
+        return window_merge(state, window_plan(state, opcodes, args))
+
+    ok_combined = max_span <= n_pages
     return Dispatch(
         name=f"vspace{n_pages}",
         make_state=make_state,
@@ -184,7 +204,9 @@ def make_vspace(n_pages: int, max_span: int = 16) -> Dispatch:
         # mod-wrapped span can revisit a page, and the event expansion
         # (one predecessor per event) diverges from the sequential fold
         # -> fall back to the scan engine there
-        window_apply=window_apply if max_span <= n_pages else None,
+        window_apply=window_apply if ok_combined else None,
+        window_plan=window_plan if ok_combined else None,
+        window_merge=window_merge if ok_combined else None,
     )
 
 
@@ -364,7 +386,7 @@ def make_vspace_radix(n_pages: int, max_span: int = 16) -> Dispatch:
     def tables(state, args):
         return jnp.sum(state["pd"]).astype(jnp.int32)
 
-    def window_apply(state, opcodes, args):
+    def window_plan(state, opcodes, args):
         """Combined replay for the 4-level radix vspace.
 
         The hardest window algebra in the repo (alongside memfs): four
@@ -402,8 +424,14 @@ def make_vspace_radix(n_pages: int, max_span: int = 16) -> Dispatch:
         5. final state: per-page last write vs last region clear; per-PD
            last update; pdpt/pml4 = init | ever-set.
 
-        Every sort/scan depends only on the window, so under the step's
-        replica vmap they hoist out and are shared by the fleet.
+        Packaged as plan/merge (r5): every sort/scan/scatter — the whole
+        O(E log E) half above — runs ONCE per window on the
+        representative replica; the vmapped `window_merge` does the
+        honest per-replica dense work (pt/pd/pdpt/pml4 blends against
+        the replica's own tables). r4 relied on XLA hoisting the sorts
+        out of the replica vmap, which it does not do for
+        gather/scatter-carrying pipelines — the split makes long-log
+        throughput scale linearly with R (BENCH_NOTES r5).
         """
         W = opcodes.shape[0]
         S = max_span
@@ -609,11 +637,6 @@ def make_vspace_radix(n_pages: int, max_span: int = 16) -> Dispatch:
             .at[clear_u[:, 0]].max(jnp.where(is_tbl, t_op, -1))[:l2]
         )
         lc_pg = lc_reg[jnp.arange(n_pages) >> 9]
-        pt_new = jnp.where(
-            (lastw >= 0) & (lw_t > lc_pg),
-            lw_v,
-            jnp.where(lc_pg >= 0, 0, init_pt),
-        )
         upd_keys = jnp.concatenate([pd_mark, clear_u], axis=1)
         Uc = _pd_w + 1
         upd_vals = jnp.broadcast_to(
@@ -625,17 +648,40 @@ def make_vspace_radix(n_pages: int, max_span: int = 16) -> Dispatch:
             .at[upd_keys.reshape(U).astype(jnp.int64)]
             .max(jnp.arange(U, dtype=jnp.int64))[:l2]
         )
-        pd_new = jnp.where(
-            lastu >= 0,
-            upd_vals.reshape(U)[jnp.clip(lastu, 0).astype(jnp.int32)],
-            init_pd,
-        )
-        pdpt_new = init_pdpt | (fs_pdpt < W)
-        pml4_new = init_pml4 | (fs_pml4 < W)
         return {
-            "pt": pt_new, "pd": pd_new, "pdpt": pdpt_new,
-            "pml4": pml4_new,
-        }, resps
+            # per-page: last in-window write (and whether it postdates
+            # the last region clear), plus the clear mask itself
+            "pt_wins": (lastw >= 0) & (lw_t > lc_pg),
+            "pt_value": lw_v,
+            "pt_cleared": lc_pg >= 0,
+            # per-PD-entry: last update (mark=True / clear=False)
+            "pd_touched": lastu >= 0,
+            "pd_value": upd_vals.reshape(U)[
+                jnp.clip(lastu, 0).astype(jnp.int32)
+            ],
+            # monotone levels: entries first set inside the window
+            "pdpt_set": fs_pdpt < W,
+            "pml4_set": fs_pml4 < W,
+            "resps": resps,
+        }
+
+    def window_merge(state, plan):
+        pt = jnp.where(
+            plan["pt_wins"], plan["pt_value"],
+            jnp.where(plan["pt_cleared"], 0, state["pt"]),
+        )
+        pd = jnp.where(plan["pd_touched"], plan["pd_value"], state["pd"])
+        return {
+            "pt": pt, "pd": pd,
+            "pdpt": state["pdpt"] | plan["pdpt_set"],
+            "pml4": state["pml4"] | plan["pml4_set"],
+        }, plan["resps"]
+
+    def window_apply(state, opcodes, args):
+        # arbitrary-state form (catch-up, divergent fleets): the plan's
+        # walk-before/epoch half reads THIS state, so the composition is
+        # the full sequential-fold semantics per replica
+        return window_merge(state, window_plan(state, opcodes, args))
 
     return Dispatch(
         name=f"vspace_radix{n_pages}",
@@ -644,4 +690,6 @@ def make_vspace_radix(n_pages: int, max_span: int = 16) -> Dispatch:
         read_ops=(identify, resolved, tables),
         arg_width=3,
         window_apply=window_apply,
+        window_plan=window_plan,
+        window_merge=window_merge,
     )

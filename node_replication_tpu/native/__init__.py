@@ -95,6 +95,10 @@ def load() -> ctypes.CDLL:
     ]
     lib.nr_execute.restype = c.c_int32
     lib.nr_execute.argtypes = [c.c_void_p, c.c_int, c.c_int, c.c_int32, i32p]
+    lib.nr_execute_batch.restype = c.c_int
+    lib.nr_execute_batch.argtypes = [
+        c.c_void_p, c.c_int, c.c_int, c.c_int, i32p, i32p, i32p,
+    ]
     lib.nr_sync.argtypes = [c.c_void_p, c.c_int]
     lib.nr_sync_log.argtypes = [c.c_void_p, c.c_int, c.c_int]
     lib.nr_state_words.restype = c.c_int64

@@ -117,6 +117,29 @@ class NativeEngine:
             )
         )
 
+    def execute_batch(self, ops: list[tuple], token: tuple[int, int]):
+        """Batched read path: one ctail gate + one read-lock hold per
+        chunk (read-side flat combining — the wr=0 rescue, r5; see
+        `nr_execute_batch` in nr_native.cpp)."""
+        rid, tid = token
+        out = []
+        for i in range(0, len(ops), self.max_batch):
+            chunk = ops[i : i + self.max_batch]
+            n = len(chunk)
+            opcodes = (ctypes.c_int32 * n)(*[int(o[0]) for o in chunk])
+            args = (ctypes.c_int32 * (3 * n))()
+            for j, o in enumerate(chunk):
+                for k, v in enumerate(o[1:4]):
+                    args[3 * j + k] = int(v)
+            resps = (ctypes.c_int32 * n)()
+            rc = self._lib.nr_execute_batch(
+                self._h, rid, tid, n, opcodes, args, resps
+            )
+            if rc != 0:
+                raise ValueError(f"read batch rejected (rc={rc})")
+            out.extend(int(r) for r in resps)
+        return out
+
     def sync(self, rid: int | None = None) -> None:
         for r in range(self.n_replicas) if rid is None else [rid]:
             self._lib.nr_sync(self._h, r)
@@ -291,4 +314,6 @@ def bench_cmp(
     }[system]
     per = (ctypes.c_uint64 * n_threads)()
     total = fn(n_threads, write_pct, keyspace, batch, duration_ms, seed, per)
+    if total == 2**64 - 1:  # FFI error sentinel (see nr_native.cpp)
+        raise ValueError(f"native cmp bench '{system}' rejected the config")
     return int(total), np.ctypeslib.as_array(per).copy()

@@ -777,6 +777,48 @@ int32_t nr_execute(Engine *e, int rid, int tid, int32_t opcode,
   return resp;
 }
 
+// Batched read path: flat combining applied to the READ side. One ctail
+// gate and one read-lock hold cover n local dispatches. The reference's
+// readers scale because its per-slot reader lock is nearly free on a big
+// NUMA box (`nr/src/rwlock.rs:148-179`); on a small host the per-op cost
+// is dominated by the seq_cst announce/check pair in read_acquire plus
+// the ctail/ltail acquire loads of the gate (r4 measured NR wr=0 LOSING
+// 2x to a contended global mutex) — so amortize them per batch, exactly
+// as nr_execute_mut_batch amortizes the log reservation per 32 writes.
+// Linearization: the lock is held across all n dispatches, so no
+// combiner can apply between them — the whole batch reads ONE state that
+// is >= every op completed before the call (the same `ltail >= ctail`
+// guarantee as the per-op path, `nr/src/replica.rs:483-497`).
+int32_t nr_execute_batch(Engine *e, int rid, int tid, int n,
+                         const int32_t *opcodes, const int32_t *args_flat,
+                         int32_t *resps_out) {
+  if (n <= 0) return 0;
+  Replica &rep = e->replicas[rid];
+  if (e->nlogs > 1) {
+    // multi-log reads gate per op (each key maps to its own log's
+    // ctail; multikey reads sync all logs) — no shared gate to amortize
+    for (int j = 0; j < n; j++)
+      resps_out[j] = nr_execute(e, rid, tid, opcodes[j],
+                                args_flat + j * (kArgW - 1));
+    return 0;
+  }
+  Log &lg = e->logs[0];
+  uint64_t c = lg.ctail.load(std::memory_order_acquire);
+  uint64_t spins = 0;
+  while (lg.ltails[rid].v.load(std::memory_order_acquire) < c) {
+    if (!try_combine(e, rid, 0)) cpu_relax();
+    if (++spins == kWarnSpins) e->warn_events.fetch_add(1);
+  }
+  nr_rwlock_read_acquire(rep.rwlock, tid);
+  for (int j = 0; j < n; j++) {
+    const int32_t *a = args_flat + j * (kArgW - 1);
+    int32_t aa[kArgW] = {a[0], a[1], a[2], 0};
+    resps_out[j] = e->model->dispatch_rd(rep.data, opcodes[j], aa);
+  }
+  nr_rwlock_read_release(rep.rwlock, tid);
+  return 0;
+}
+
 // Catch replica rid up on every log (`Replica::sync`,
 // `nr/src/replica.rs:469-479`; all-logs loop `cnr/src/replica.rs:579-597`).
 void nr_sync(Engine *e, int rid) {
@@ -866,9 +908,12 @@ uint64_t nr_bench_hashmap(Engine *e, int threads_per_replica, int write_pct,
       int32_t opcodes[kMaxBatch];
       int32_t args[kMaxBatch][3];
       int32_t resps[kMaxBatch];
+      int32_t r_opcodes[kMaxBatch];
+      int32_t r_args[kMaxBatch][3];
+      int32_t r_resps[kMaxBatch];
       while (!stop.load(std::memory_order_relaxed)) {
         batch_start = done;
-        int nw = 0;
+        int nw = 0, nrd = 0;
         for (int j = 0; j < batch; j++) {
           uint64_t r = splitmix(rng);
           int32_t key = (int32_t)(r % (uint64_t)keyspace);
@@ -881,10 +926,20 @@ uint64_t nr_bench_hashmap(Engine *e, int threads_per_replica, int write_pct,
             args[nw][2] = 0;
             nw++;
           } else {
-            int32_t a[3] = {key, 0, 0};
-            nr_execute(e, rid, tid, 1, a);  // get
-            done++;
+            r_opcodes[nrd] = 1;  // get
+            r_args[nrd][0] = key;
+            r_args[nrd][1] = 0;
+            r_args[nrd][2] = 0;
+            nrd++;
           }
+        }
+        if (nrd > 0) {
+          // reads ride the batched read path: one ctail gate + one
+          // read-lock hold for the whole run (the read-side flat
+          // combining that rescued wr=0 on this host, r5)
+          nr_execute_batch(e, rid, tid, nrd, r_opcodes, &r_args[0][0],
+                           r_resps);
+          done += nrd;
         }
         if (nw > 0) {
           // one flat-combining batch either way: in CNR mode the record's
@@ -1157,9 +1212,11 @@ uint64_t nr_bench_cmp_lockfree(int n_threads, int write_pct,
                                int duration_ms, uint64_t seed,
                                uint64_t *out_per_thread) {
   if (keyspace < 1) keyspace = 1;
-  // table capacity is bounded (2^27 slots = 1 GiB); the Python wrapper
-  // rejects larger keyspaces instead of silently reshaping the workload
-  if (keyspace > (int64_t)1 << 26) return 0;
+  // table capacity is bounded (2^27 slots = 1 GiB); oversized keyspaces
+  // return UINT64_MAX as an unmistakable error sentinel (a zero would
+  // read as a real 0-ops measurement to any caller that skips the
+  // Python wrapper's pre-check)
+  if (keyspace > (int64_t)1 << 26) return UINT64_MAX;
   uint64_t cap = 1;
   while (cap < (uint64_t)keyspace * 2) cap <<= 1;
   const uint64_t mask = cap - 1;

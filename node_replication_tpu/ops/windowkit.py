@@ -99,7 +99,7 @@ def slot_resolve(slot_upd, upd_val, slot_qry, init_vals, n_slots: int):
     init_q = init_vals.at[
         jnp.minimum(sk, n_slots - 1).astype(jnp.int32)
     ].get(mode="clip")
-    resolved_s = jnp.where(ph & ~is_upd[order], pv, init_q)
+    resolved_s = jnp.where(ph & is_qry[order], pv, init_q)
     return jnp.zeros((W,), init_vals.dtype).at[order].set(resolved_s)
 
 

@@ -72,6 +72,11 @@ def drive(e, n_threads, mixed_logs, keyspace):
                 ]
                 e.execute_mut_batch(ops, tok)
                 e.execute((1, (g + n) % keyspace), tok)
+                # batched read path: read-lock held across the batch,
+                # racing other threads' combiners (r5)
+                e.execute_batch(
+                    [(1, (g + n + j) % keyspace) for j in range(8)], tok
+                )
                 if mixed_logs:
                     # multikey relaxed read racing the writers
                     e.execute((2, 0, keyspace), tok)

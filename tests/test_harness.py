@@ -161,6 +161,31 @@ class TestSweepAndCsv:
             rows = list(csv.DictReader(f))
         assert {r["rs"] for r in rows} == {"2", "4"}
         assert all(int(r["ops"]) > 0 for r in rows)
+        # wr_eff records the EFFECTIVE ratio split_write_read realized:
+        # wr=50 at batch 8 is exactly 4/8 (r2→r4 carryover closed in r5)
+        assert all(float(r["wr_eff"]) == 50.0 for r in rows)
+
+    def test_csv_schema_upgrade_pads_old_rows(self, tmp_path):
+        # a committed CSV that predates wr_eff gets upgraded in place:
+        # the old rows keep "" in the new column, new rows carry values
+        from node_replication_tpu.harness.mkbench import (
+            _append_csv,
+            _CSV_FIELDS,
+        )
+
+        path = tmp_path / "scaleout_benchmarks.csv"
+        old_fields = [f for f in _CSV_FIELDS if f != "wr_eff"]
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=old_fields)
+            w.writeheader()
+            w.writerow({k: "1" for k in old_fields})
+        _append_csv(str(path), _CSV_FIELDS,
+                    [dict({k: "2" for k in old_fields}, wr_eff=9.4)])
+        with open(path) as f:
+            rows = list(csv.DictReader(f))
+        assert rows[0]["wr_eff"] == ""
+        assert rows[1]["wr_eff"] == "9.4"
+        assert [r["name"] for r in rows] == ["1", "2"]
 
     def test_baseline_comparison_writes_csv(self, tmp_path):
         res = baseline_comparison(

@@ -87,6 +87,31 @@ class TestEngineBasics:
             # read on the OTHER replica must observe the ctail'd write
             assert e.execute((1, 7), t1) == 123
 
+    def test_batched_reads_match_per_op(self):
+        # read-side flat combining (r5): one ctail gate + one lock hold
+        # per batch, same answers as the per-op path, including across
+        # replicas and chunking past the 32-slot batch limit
+        with NativeEngine(MODEL_HASHMAP, 64, n_replicas=2) as e:
+            t0, t1 = e.register(0), e.register(1)
+            e.execute_mut_batch(
+                [(1, k, k * 3 + 1) for k in range(40)], t0
+            )
+            reads = [(1, k) for k in range(64)]
+            want = [e.execute(op, t0) for op in reads]
+            assert e.execute_batch(reads, t0) == want
+            assert e.execute_batch(reads, t1) == want
+            assert want[:40] == [k * 3 + 1 for k in range(40)]
+            assert want[40:] == [-1] * 24
+
+    def test_batched_reads_multilog(self):
+        # CNR mode: the batch falls back to per-op gating (each key has
+        # its own log's ctail) but keeps the one-call surface
+        with NativeEngine(MODEL_HASHMAP, 64, n_replicas=2, nlogs=4) as e:
+            t0 = e.register(0)
+            e.execute_mut_batch([(1, k, 100 + k) for k in range(16)], t0)
+            got = e.execute_batch([(1, k) for k in range(20)], t0)
+            assert got == [100 + k for k in range(16)] + [-1] * 4
+
 
 class TestLogWrap:
     def test_wraparound_and_gc(self):

@@ -245,3 +245,102 @@ class TestConvergence:
         nr.flush()
         nr.sync()
         assert nr.replicas_equal()
+
+
+class TestGrowFleet:
+    """Dynamic replica registration (`Log::register`,
+    `nr/src/log.rs:272-292`; `Replica::new` joins a live log,
+    `nr/src/replica.rs:184-232`): replicas join mid-run, converge to
+    bit-equality, and subsequent operations include them."""
+
+    def test_join_mid_run_converges_and_participates(self):
+        nr = small_nr(make_hashmap(32), n_replicas=2)
+        t0 = nr.register(0)
+        for i in range(20):
+            nr.execute_mut((HM_PUT, i % 32, i + 1), t0)
+        [rid] = nr.grow_fleet(1)
+        assert rid == 2 and nr.n_replicas == 3
+        assert nr.replicas_equal()  # newcomer caught up to bit-equality
+        t2 = nr.register(rid)
+        # the fleet's subsequent steps include the newcomer: write from
+        # it, read it back from an ORIGINAL replica and vice versa
+        nr.execute_mut((HM_PUT, 7, 777), t2)
+        assert nr.execute((HM_GET, 7), t0) == 777
+        nr.execute_mut((HM_PUT, 9, 999), t0)
+        assert nr.execute((HM_GET, 9), t2) == 999
+        nr.sync()
+        assert nr.replicas_equal()
+
+    def test_join_after_ring_wrap(self):
+        # the case the reference's position-0 + Default join CANNOT
+        # handle: by the time the newcomer joins, early entries have been
+        # overwritten; the donor-snapshot join doesn't care
+        nr = small_nr(make_hashmap(16), n_replicas=2)
+        t0 = nr.register(0)
+        for i in range(600):  # log_entries=256 → multiple wraps
+            nr.execute_mut((HM_PUT, i % 16, i), t0)
+        assert int(nr.log.tail) > nr.spec.capacity
+        [rid] = nr.grow_fleet(1)
+        assert nr.replicas_equal()
+        t2 = nr.register(rid)
+        assert nr.execute((HM_GET, 3), t2) == 595  # last write of key 3
+
+    def test_join_multiple_and_divergent_donor(self):
+        # grow by 2 at once; donor is chosen as the most caught-up
+        # replica, so convergence holds even before a global sync
+        nr = small_nr(make_stack(64), n_replicas=2)
+        t0 = nr.register(0)
+        for i in range(10):
+            nr.execute_mut((ST_PUSH, i), t0)
+        rids = nr.grow_fleet(2)
+        assert rids == [2, 3] and nr.n_replicas == 4
+        assert nr.replicas_equal()
+        t3 = nr.register(rids[1])
+        assert nr.execute_mut((ST_POP, 0), t3) == 9
+        nr.sync()
+        assert nr.replicas_equal()
+
+    def test_grow_validation(self):
+        nr = small_nr(make_hashmap(8))
+        with pytest.raises(ValueError):
+            nr.grow_fleet(0)
+        with pytest.raises(ValueError):
+            nr.grow_fleet(1, donor=5)
+
+    def test_harness_runner_grow(self):
+        # dynamic registration under the harness: widen a live
+        # ReplicatedRunner between steps; accounting and convergence hold
+        import jax.numpy as jnp
+
+        from node_replication_tpu.harness.trait import ReplicatedRunner
+
+        d = make_hashmap(16)
+        r = ReplicatedRunner(d, n_replicas=2, writes_per_replica=2,
+                             reads_per_replica=1)
+        rng = np.random.default_rng(0)
+
+        def batches(R, S):
+            wr_opc = np.full((S, R, 2), HM_PUT, np.int32)
+            wr_args = np.zeros((S, R, 2, 3), np.int32)
+            wr_args[..., 0] = rng.integers(0, 16, (S, R, 2))
+            wr_args[..., 1] = rng.integers(1, 99, (S, R, 2))
+            rd_opc = np.full((S, R, 1), HM_GET, np.int32)
+            rd_args = np.zeros((S, R, 1, 3), np.int32)
+            rd_args[..., 0] = rng.integers(0, 16, (S, R, 1))
+            return wr_opc, wr_args, rd_opc, rd_args
+
+        r.prepare(*batches(2, 3))
+        for s in range(3):
+            r.run_step(s)
+        r.block()
+        tail_before = int(r.log.tail)
+        r.grow(2)
+        assert r.n_replicas == 4
+        r.prepare(*batches(4, 3))
+        for s in range(3):
+            r.run_step(s)
+        r.block()
+        assert r.replicas_equal()
+        # wider fleet appends 4*2 per step
+        assert int(r.log.tail) == tail_before + 3 * 8
+        assert (np.asarray(r.log.ltails) == int(r.log.tail)).all()

@@ -108,6 +108,78 @@ class TestValidation:
             make_step(d, spec, writes_per_replica=16, reads_per_replica=1)
 
 
+class TestLockstepGuard:
+    def test_divergent_states_raise_under_check(self):
+        # The plan/merge fast path imposes replica-0's plan on the fleet;
+        # with check_lockstep=True an out-of-contract divergent fleet
+        # raises instead of silently answering from the wrong state.
+        from jax.experimental import checkify
+
+        R, Bw = 2, 2
+        d = make_stack(64)
+        spec = LogSpec(capacity=1024, n_replicas=R, arg_width=3,
+                       gc_slack=16)
+        step = make_step(d, spec, Bw, 1, donate=False,
+                         check_lockstep=True)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), R)
+        # hand-divergence: replica 1's buffer differs from replica 0's
+        states = dict(states)
+        states["buf"] = states["buf"].at[1, 0].set(777)
+        wr_opc = np.full((R, Bw), ST_PUSH, np.int32)
+        wr_args = np.zeros((R, Bw, 3), np.int32)
+        rd = np.zeros((R, 1), np.int32)
+        rda = np.zeros((R, 1, 3), np.int32)
+        with pytest.raises(checkify.JaxRuntimeError):
+            step(log, states, jnp.asarray(wr_opc), jnp.asarray(wr_args),
+                 jnp.asarray(rd), jnp.asarray(rda))
+
+    def test_divergent_cursors_raise_for_window_apply_models(self):
+        # window_apply-only combined steps force ltails = tail after
+        # replaying just the appended span, so divergent cursors on
+        # entry mean silently skipped entries — the guard catches it
+        from jax.experimental import checkify
+
+        R, Bw, K = 2, 2, 16
+        d = make_hashmap(K)
+        assert d.window_plan is None and d.window_apply is not None
+        spec = LogSpec(capacity=1024, n_replicas=R, arg_width=3,
+                       gc_slack=16)
+        step = make_step(d, spec, Bw, 1, donate=False,
+                         check_lockstep=True)
+        log = log_init(spec)
+        # replica 1's cursor lags the tail (hand-built divergence)
+        log = log._replace(tail=log.tail + 4,
+                           ltails=log.ltails.at[0].set(4))
+        states = replicate_state(d.init_state(), R)
+        wr_opc = np.full((R, Bw), HM_PUT, np.int32)
+        wr_args = np.zeros((R, Bw, 3), np.int32)
+        rd = np.zeros((R, 1), np.int32)
+        rda = np.zeros((R, 1, 3), np.int32)
+        with pytest.raises(checkify.JaxRuntimeError):
+            step(log, states, jnp.asarray(wr_opc), jnp.asarray(wr_args),
+                 jnp.asarray(rd), jnp.asarray(rda))
+
+    def test_lockstep_fleet_passes_under_check(self):
+        R, Bw = 2, 2
+        d = make_stack(64)
+        spec = LogSpec(capacity=1024, n_replicas=R, arg_width=3,
+                       gc_slack=16)
+        step = make_step(d, spec, Bw, 1, donate=False,
+                         check_lockstep=True)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), R)
+        wr_opc = np.full((R, Bw), ST_PUSH, np.int32)
+        wr_args = np.zeros((R, Bw, 3), np.int32)
+        rd = np.zeros((R, 1), np.int32)
+        rda = np.zeros((R, 1, 3), np.int32)
+        log, states, wr_resps, _ = step(
+            log, states, jnp.asarray(wr_opc), jnp.asarray(wr_args),
+            jnp.asarray(rd), jnp.asarray(rda))
+        want = np.arange(1, R * Bw + 1).reshape(R, Bw)
+        np.testing.assert_array_equal(np.asarray(wr_resps), want)
+
+
 class TestUnknownOpcodes:
     def test_out_of_range_opcodes_are_inert(self):
         # Contract shared with the native engine: unknown opcodes replay

@@ -502,6 +502,110 @@ class TestQueueWindowApply:
         assert [int(x) for x in got_resps] == ref_resps
 
 
+class TestCombinedCatchup:
+    """`log_catchup_all`: combined replay on DIVERGENT cursors — the
+    catch-up-at-hot-loop-speed contract (`nr/src/log.rs:473-524`).
+    Bit-identical to `log_exec_all` per round: states, resps, cursors."""
+
+    def _drive(self, d, make_state, seed, model_args):
+        from node_replication_tpu.core.log import (
+            log_append,
+            log_catchup_all,
+            log_exec_all,
+        )
+
+        R, N, W = 4, 96, 32
+        spec = LogSpec(capacity=256, n_replicas=R, arg_width=3,
+                       gc_slack=16)
+        rng = np.random.default_rng(seed)
+        opcodes = jnp.asarray(
+            rng.choice([0, 1, 2, 9], size=N, p=[0.1, 0.5, 0.3, 0.1]),
+            jnp.int32,
+        )
+        args = jnp.asarray(
+            np.stack([rng.integers(0, model_args, N),
+                      rng.integers(1, 100, N),
+                      np.zeros(N)], axis=1),
+            jnp.int32,
+        )
+        outs = {}
+        for eng in (log_exec_all, log_catchup_all):
+            log = log_init(spec)
+            log = log_append(spec, log, opcodes, args, N)
+            states = replicate_state(d.init_state(), R)
+            rounds = []
+            # limited rounds diverge the fleet (replica 2 fully dormant),
+            # then unlimited rounds converge it — GC stalls in between
+            limit_rounds = [jnp.asarray([10, 35, 0, N], jnp.int64),
+                            jnp.asarray([60, 35, 0, N], jnp.int64)]
+            for lim in limit_rounds:
+                log, states, resps = eng(spec, d, log, states, W, lim)
+                rounds.append((np.asarray(resps),
+                               np.asarray(log.ltails),
+                               int(log.head), int(log.ctail)))
+            while int(np.min(np.asarray(log.ltails))) < N:
+                log, states, resps = eng(spec, d, log, states, W)
+                rounds.append((np.asarray(resps),
+                               np.asarray(log.ltails),
+                               int(log.head), int(log.ctail)))
+            outs[eng.__name__] = (jax.tree.map(np.asarray, states), rounds)
+        st_scan, r_scan = outs["log_exec_all"]
+        st_comb, r_comb = outs["log_catchup_all"]
+        assert len(r_scan) == len(r_comb)
+        for (ra, la, ha, ca), (rb, lb, hb, cb) in zip(r_scan, r_comb):
+            np.testing.assert_array_equal(ra, rb)
+            np.testing.assert_array_equal(la, lb)
+            assert ha == hb and ca == cb
+        for a, b in zip(jax.tree.leaves(st_scan), jax.tree.leaves(st_comb)):
+            np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_hashmap_divergent_cursors(self, seed):
+        self._drive(make_hashmap(13), None, seed, 13)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_stack_divergent_cursors(self, seed):
+        # order-dependent model on divergent state: exactly the case the
+        # plan/merge fast path excludes and window_apply must cover
+        from node_replication_tpu.models import make_stack
+
+        self._drive(make_stack(9), None, seed, 50)
+
+    @pytest.mark.parametrize("seed", [0])
+    def test_queue_divergent_cursors(self, seed):
+        from node_replication_tpu.models import make_queue
+
+        self._drive(make_queue(9), None, seed, 50)
+
+    def test_node_replicated_engines_agree(self):
+        # whole-wrapper drive: per-op API with interleaved sync on both
+        # engines, responses and final states bit-equal
+        from node_replication_tpu.core.replica import NodeReplicated
+        from node_replication_tpu.models import HM_PUT, HM_REMOVE
+
+        rng = np.random.default_rng(3)
+        ops = [
+            (int(rng.choice([HM_PUT, HM_REMOVE])),
+             int(rng.integers(0, 16)), int(rng.integers(1, 50)))
+            for _ in range(40)
+        ]
+        outs = {}
+        for eng in ("scan", "combined"):
+            nr = NodeReplicated(make_hashmap(16), n_replicas=2,
+                                log_entries=512, gc_slack=16, engine=eng)
+            assert nr.engine == eng
+            t0, t1 = nr.register(0), nr.register(1)
+            resps = []
+            for i, op in enumerate(ops):
+                resps.append(nr.execute_mut(op, t0 if i % 2 else t1))
+            nr.sync()
+            outs[eng] = (resps, jax.tree.map(np.asarray, nr.states))
+        assert outs["scan"][0] == outs["combined"][0]
+        for a, b in zip(jax.tree.leaves(outs["scan"][1]),
+                        jax.tree.leaves(outs["combined"][1])):
+            np.testing.assert_array_equal(a, b)
+
+
 class TestMultilogCombined:
     @pytest.mark.parametrize("seed", [0, 1])
     def test_partitioned_combined_matches_scan(self, seed):
