@@ -14,6 +14,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# --cpu must take effect BEFORE any jax backend initializes (package
+# imports can trigger it; switching platforms after init is silently
+# ignored and a "--cpu" sweep would measure the real chip — r5 found a
+# sharded-cnr "virtual mesh" run that was actually the 1-chip tunnel).
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 
 def base_parser(desc: str) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=desc)
@@ -34,7 +43,15 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
 
 def finish_args(args):
     if args.cpu:
+        # the platform switch happened at module import (above), before
+        # any backend could initialize — here we only VERIFY it took,
+        # so a wrapper that rewrites argv can't silently measure the
+        # real chip under a --cpu label
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
+        assert jax.devices()[0].platform == "cpu", (
+            "--cpu requested but the active backend is "
+            f"{jax.devices()[0].platform}; the flag must be on the "
+            "command line before jax initializes (see common.py)"
+        )
     return args

@@ -94,7 +94,7 @@ def main():
     if args.cmp:
         from node_replication_tpu.native import bench_cmp
 
-        for system in ("mutex", "lockfree", "partitioned"):
+        for system in ("mutex", "lockfree", "evmap", "partitioned"):
             total, per = bench_cmp(
                 system, n_threads, write_pct, keys, duration_ms=dur_ms
             )

@@ -39,11 +39,32 @@ def main():
     p.add_argument("--slots", type=int, default=None,
                    help="--sparse: initial table slots (default 2x the "
                         "keyspace working set)")
+    p.add_argument("--attempts", type=int, default=1,
+                   help="contention-aware measurement: re-measure each "
+                        "config in up to this many 3-repeat windows "
+                        "until the spread is <=5%% (the flagship "
+                        "bench's retry policy applied to the sweep); "
+                        "accepted spread/attempts annotate the skew "
+                        "sidecar CSV")
+    p.add_argument("--replay", choices=["scan", "pallas"],
+                   default="scan",
+                   help="--sparse replay engine: 'scan' = the generic "
+                        "per-entry loop (the only algebra-free option "
+                        "for this order-dependent probe-RMW model); "
+                        "'pallas' = the in-VMEM probe-window kernel "
+                        "(ops/pallas_oahashmap.py)")
     args = finish_args(p.parse_args())
     if args.logs and not args.cmp:
         p.error("--logs selects CNR log counts and needs --cmp")
     if args.logs and not any(L > 1 for L in args.logs):
         p.error("--logs needs at least one value > 1 (CNR log counts)")
+    if args.attempts > 1 and args.sparse:
+        p.error("--attempts applies to the ScaleBench sweep, not "
+                "--sparse (the sparse path has its own grow-and-rerun "
+                "loop)")
+    if args.replay != "scan" and not args.sparse:
+        p.error("--replay selects the --sparse engine; the main sweep "
+                "is driven by the builder's default engine selection")
 
     keys = args.keys or (1 << 22 if args.full else 10_000)
     dist = "skewed" if args.skewed else "uniform"
@@ -80,6 +101,7 @@ def main():
             .batches(args.batch)
             .systems(systems)
             .duration(args.duration)
+            .attempts(args.attempts)
             .out_dir(args.out_dir)
             .run()
         )
@@ -90,8 +112,17 @@ def sparse_bench(args, keys, dist):
     the -2 window-full responses on device during the measured run,
     reports the drop rate, and GROWS the table (2x slots) and re-runs
     when any write dropped — sized right, drops are a non-event."""
+    import os
+
     from node_replication_tpu.harness import generate_batches
-    from node_replication_tpu.harness.mkbench import measure_step_runner
+    from node_replication_tpu.harness.mkbench import (
+        SCALEOUT_CSV,
+        _append_csv,
+        _CSV_FIELDS,
+        effective_write_pct,
+        measure_step_runner,
+        sweep_rows,
+    )
     from node_replication_tpu.harness.trait import ReplicatedRunner
     from node_replication_tpu.models import make_oahashmap
     from node_replication_tpu.models.oahashmap import DROPPED
@@ -104,19 +135,52 @@ def sparse_bench(args, keys, dist):
     spec = WorkloadSpec(keyspace=keys, write_ratio=wr, distribution=dist,
                         seed=args.seed)
     gen = generate_batches(spec, 16, R, bw, br)
+
+    class PallasOaRunner(ReplicatedRunner):
+        """ReplicatedRunner with the replay swapped for the in-VMEM
+        probe-window kernel (`ops/pallas_oahashmap.py`) — the rescue
+        path for the order-dependent probe-RMW class the scan otherwise
+        owns. Same log, same accounting, plane-layout state."""
+
+        def __init__(self, slots, R, Bw, Br):
+            from node_replication_tpu.ops.pallas_oahashmap import (
+                make_pallas_oahashmap_step,
+                pallas_oahashmap_state,
+            )
+
+            super().__init__(make_oahashmap(slots), R, Bw, Br,
+                             make_engine=False, track_resp=DROPPED)
+            self.name = "nr-pallas"
+            self.step = make_pallas_oahashmap_step(
+                slots, 16, self.spec, Bw, Br
+            )
+            self.states = pallas_oahashmap_state(slots, R)
+
     for attempt in range(4):
-        runner = ReplicatedRunner(
-            make_oahashmap(slots), R, bw, br, track_resp=DROPPED
-        )
+        if args.replay == "pallas":
+            runner = PallasOaRunner(slots, R, bw, br)
+        else:
+            runner = ReplicatedRunner(
+                make_oahashmap(slots), R, bw, br, track_resp=DROPPED
+            )
         res = measure_step_runner(runner, *gen,
                                   duration_s=args.duration)
         drops, writes = runner.tracked_rate()
         rate = drops / max(writes, 1)
-        print(f">> oahashmap{slots} R={R} wr={wr}% dist={dist}: "
-              f"{res.client_mops:.2f} Mops client "
+        print(f">> oahashmap{slots}/{runner.name} R={R} wr={wr}% "
+              f"dist={dist}: {res.client_mops:.2f} Mops client "
               f"({res.mops:.2f} Mops replayed) | drops {drops}/{writes} "
               f"({100 * rate:.3f}%)")
         if drops == 0:
+            # only drop-free configs are committed: a dropping table is
+            # a mis-sized workload, not a measurement
+            _append_csv(
+                os.path.join(args.out_dir, SCALEOUT_CSV), _CSV_FIELDS,
+                sweep_rows(
+                    f"oahashmap{slots}", runner.name, res, R, 1,
+                    args.batch[0], wr_eff=effective_write_pct(bw, br),
+                ),
+            )
             break
         if attempt == 3:
             print(f"## giving up after 4 attempts: {100 * rate:.3f}% of "

@@ -63,21 +63,33 @@ def main():
                    help="replay engine (see module docstring: 'scan' is "
                         "the per-entry reference-faithful loop, 'auto' "
                         "uses the combined window reduction)")
+    p.add_argument("--systems", nargs="+",
+                   default=["nr", "cnr", "partitioned"],
+                   help="systems to sweep; add 'sharded-cnr' for the "
+                        "device-mesh CNR runner (logs over the mesh "
+                        "'log' axis — on one chip it degrades to a 1x1 "
+                        "mesh, on a virtual 8-device CPU mesh it "
+                        "measures the sharded program end to end)")
+    p.add_argument("--tag", default="",
+                   help="suffix appended to the workload name in CSV "
+                        "rows (e.g. '-virt8mesh' for virtual-mesh runs)")
     args = finish_args(p.parse_args())
     keys = args.keys or (1 << 20 if args.full else 1 << 14)
     dist = "skewed" if args.skewed else "uniform"
 
+    name = (f"sortedset{keys}-{dist}" if args.skewed
+            else f"sortedset{keys}") + args.tag
     builder = (
         ScaleBenchBuilder(
             lambda: make_sortedset(keys),
-            f"sortedset{keys}-{dist}" if args.skewed else f"sortedset{keys}",
+            name,
             WorkloadSpec(keyspace=keys, write_ratio=80, distribution=dist,
                          seed=args.seed),
         )
         .replicas(args.replicas)
         .log_strategies(args.logs)
         .batches(args.batch)
-        .systems(["nr", "cnr", "partitioned"])
+        .systems(args.systems)
         .duration(args.duration)
         .out_dir(args.out_dir)
         .replay(args.replay)

@@ -36,13 +36,16 @@ def main():
                    help="BASELINE.md long-log replay config: "
                         "pages=2^18, span=64, batch=1024")
     p.add_argument("--replay",
-                   choices=["auto", "scan", "combined", "pallas"],
+                   choices=["auto", "scan", "combined", "pallas",
+                            "pallas-plan"],
                    default="auto",
                    help="replay engine ('scan' = the faithful per-entry "
                         "reference-loop analog; 'auto'/'combined' = the "
-                        "r4 combined window reduction; 'pallas' = the "
-                        "in-VMEM sequential span kernel, "
-                        "ops/pallas_vspace.py)")
+                        "combined window reduction (plan/merge split, "
+                        "r5); 'pallas' = the in-VMEM grouped span "
+                        "kernel; 'pallas-plan' = the r5 fleet-scale "
+                        "engine: canonical-replica kernel plan + "
+                        "vmapped model-side merge)")
     args = finish_args(p.parse_args())
     if args.long_log:
         pages = args.pages or (1 << 18)
@@ -81,8 +84,32 @@ def main():
             )
             self.states = pallas_vspace_state(pages, R, radix, None)
 
+    class PallasPlanRunner(ReplicatedRunner):
+        """ReplicatedRunner on the r5 fleet-scale engine: the span
+        kernel plans the window ONCE on a canonical replica (fixed-size
+        chunks, window-independent compile) and the model's
+        `window_merge` does the per-replica dense replay, vmapped in
+        model layout (`ops/pallas_vspace.make_pallas_vspace_plan_step`).
+        """
+
+        def __init__(self, dispatch, pages, span, radix, R, Bw, Br):
+            from node_replication_tpu.core.replica import (
+                replicate_state,
+            )
+            from node_replication_tpu.ops.pallas_vspace import (
+                make_pallas_vspace_plan_step,
+            )
+
+            super().__init__(dispatch, R, Bw, Br, make_engine=False)
+            self.name = "nr-pallas-plan"
+            self.step = make_pallas_vspace_plan_step(
+                pages, self.spec, Bw, Br, span, radix=radix,
+                dispatch=dispatch,
+            )
+            self.states = replicate_state(dispatch.init_state(), R)
+
     combined = {"auto": None, "scan": False, "combined": True,
-                "pallas": None}[args.replay]
+                "pallas": None, "pallas-plan": None}[args.replay]
     # write mix: maps dominate, with device maps, unmaps, and (radix)
     # table teardowns; npages rides args[1] and clips to --span
     wr_mix = (1, 1, 1, 2) if args.flat else (1, 1, 1, 2, 3, 4)
@@ -105,6 +132,10 @@ def main():
             wr_args[..., 2] = 1 + (wr_args[..., 1] % args.span)
             if args.replay == "pallas":
                 runner = PallasVspaceRunner(
+                    model(), pages, args.span, not args.flat, R, batch, 1
+                )
+            elif args.replay == "pallas-plan":
+                runner = PallasPlanRunner(
                     model(), pages, args.span, not args.flat, R, batch, 1
                 )
             else:

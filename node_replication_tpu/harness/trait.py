@@ -676,6 +676,16 @@ class ShardedCnrRunner(MultiLogRunner):
                 _states_spec_tree(self.states, self.mesh),
                 logsh, logsh, logsh, repsh, repsh,
             ),
+            # pin outputs too: without this XLA may hand back e.g.
+            # ltails replicated over 'replica', and the NEXT step's
+            # in_shardings reject it (hit by the partitioned-combined
+            # path on a 2x4 mesh, r5)
+            out_shardings=(
+                _log_spec_tree(self.ml, self.mesh),
+                _states_spec_tree(self.states, self.mesh),
+                NamedSharding(self.mesh, P("log", "replica")),
+                repsh,
+            ),
             donate_argnums=(0, 1),
         )
 

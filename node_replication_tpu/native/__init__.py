@@ -137,7 +137,7 @@ def load() -> ctypes.CDLL:
     lib.nr_bench_rwlock.argtypes = [c.c_int, c.c_int, c.c_int, u64p]
     # comparison baselines (non-NR systems under the same workload loop)
     for fn in (lib.nr_bench_cmp_mutex, lib.nr_bench_cmp_partitioned,
-               lib.nr_bench_cmp_lockfree):
+               lib.nr_bench_cmp_lockfree, lib.nr_bench_cmp_evmap):
         fn.restype = c.c_uint64
         fn.argtypes = [
             c.c_int, c.c_int, c.c_int64, c.c_int, c.c_int, c.c_uint64, u64p,

@@ -295,21 +295,26 @@ def bench_cmp(
     'mutex' = one std::unordered_map behind a mutex; 'lockfree' = a
     shared lock-free open-addressing map (wait-free readers — the
     urcu-class competitive middle of the reference's headline graphs,
-    `benches/hashmap_comparisons.rs:281-435`); 'partitioned' = one
-    private map per thread over its key congruence class (the no-sharing
-    ceiling). Returns (total_ops, per_thread_ops)."""
+    `benches/hashmap_comparisons.rs:281-435`); 'evmap' = a left-right
+    reader/writer-split map (two copies, epoch-pinned wait-free reads,
+    single-writer apply-flip-drain-replay — the read-optimized
+    specialist the reference's hashbench drives,
+    `benches/hashbench.rs:26-105`); 'partitioned' = one private map per
+    thread over its key congruence class (the no-sharing ceiling).
+    Returns (total_ops, per_thread_ops)."""
     from node_replication_tpu.native import load
 
-    if system == "lockfree" and keyspace > (1 << 26):
+    if system in ("lockfree", "evmap") and keyspace > (1 << 26):
         raise ValueError(
-            "lockfree cmp map caps keyspace at 2^26 (its fixed "
-            "open-addressing table would exceed 1 GiB); shrink --keys "
-            "for the comparison sweep"
+            f"{system} cmp map caps keyspace at 2^26 (its fixed "
+            "table(s) would exceed 1 GiB); shrink --keys for the "
+            "comparison sweep"
         )
     lib = load()
     fn = {
         "mutex": lib.nr_bench_cmp_mutex,
         "lockfree": lib.nr_bench_cmp_lockfree,
+        "evmap": lib.nr_bench_cmp_evmap,
         "partitioned": lib.nr_bench_cmp_partitioned,
     }[system]
     per = (ctypes.c_uint64 * n_threads)()

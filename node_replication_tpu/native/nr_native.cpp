@@ -1196,6 +1196,144 @@ uint64_t nr_bench_cmp_partitioned(int n_threads, int write_pct,
   return total;
 }
 
+// The READ-OPTIMIZED comparison class: a left-right (evmap-style)
+// reader/writer-split map — the specialist the reference brackets NR
+// against on read-mostly mixes (`benches/hashbench.rs:26-105` drives
+// evmap; its README graphs lead with it). Two dense table copies;
+// readers pin the active copy by announcing an epoch in a padded
+// per-thread slot (one release store + one acquire load per BATCH of
+// reads — wait-free, no RMW on the read path at all, cheaper than the
+// lock-free map's CAS-free-but-atomic probe loop); the writer (one
+// mutex among writers, as evmap serializes via its WriteHandle) applies
+// a batch to the standby copy, flips `active`, waits for readers still
+// pinned to the old epoch to drain, then replays the same batch onto
+// the other copy so both stay converged. Strongest at wr=0 (reads never
+// see a writer's cache line); collapses under writes (every write is
+// applied twice + an epoch drain) — exactly the trade the reference's
+// evmap rows show.
+uint64_t nr_bench_cmp_evmap(int n_threads, int write_pct, int64_t keyspace,
+                            int batch, int duration_ms, uint64_t seed,
+                            uint64_t *out_per_thread) {
+  if (keyspace < 1) keyspace = 1;
+  // the SAME open-addressing layout as the lockfree map (power-of-two
+  // table, 2x keyspace, mixed hash, (key+1)<<32|value packing) so the
+  // bracket isolates the sync protocol — left-right copies vs per-op
+  // atomics — instead of rewarding a degenerate direct-mapped array
+  // (the r4-review rule applied to this system)
+  if (keyspace > (int64_t)1 << 26) return UINT64_MAX;  // 2x1 GiB cap
+  uint64_t cap = 1;
+  while (cap < (uint64_t)keyspace * 2) cap <<= 1;
+  const uint64_t mask = cap - 1;
+  std::vector<uint64_t> tbl[2];
+  tbl[0].assign(cap, 0);
+  tbl[1].assign(cap, 0);
+  std::atomic<int> active{0};
+  // per-thread epoch pin: -1 = not reading; else the copy index pinned
+  static_assert(sizeof(PaddedAtomicU64) == 64, "padding");
+  std::vector<PaddedAtomicU64> pins(n_threads);
+  for (auto &p : pins) p.v.store((uint64_t)-1, std::memory_order_relaxed);
+  std::mutex wmu;
+  std::vector<std::thread> ts;
+  std::vector<uint64_t> counts(n_threads, 0);
+  std::atomic<bool> go{false}, stop{false};
+  if (batch < 1) batch = 1;
+  for (int g = 0; g < n_threads; g++) {
+    ts.emplace_back([&, g]() {
+      uint64_t rng = seed + 0x1000 * g + 1;
+      std::vector<std::pair<int64_t, int64_t>> wbuf;
+      std::vector<int64_t> rkeys(batch);
+      wbuf.reserve(batch);
+      while (!go.load(std::memory_order_acquire)) cpu_relax();
+      uint64_t done = 0;
+      volatile int64_t sink = 0;
+      while (!stop.load(std::memory_order_relaxed)) {
+        wbuf.clear();
+        int nrd = 0;
+        for (int j = 0; j < batch; j++) {
+          uint64_t r = splitmix(rng);
+          int64_t key = (int64_t)(r % (uint64_t)keyspace);
+          if ((int)((r >> 40) % 100) < write_pct)
+            wbuf.emplace_back(key, (int64_t)(r >> 33));
+          else
+            rkeys[nrd++] = key;
+        }
+        if (nrd > 0) {
+          // pin the active copy once per read batch (seq_cst on the
+          // pin/check pair: the writer's flip-then-scan must not pass
+          // our pin-then-read on non-TSO targets)
+          int a = active.load(std::memory_order_seq_cst);
+          pins[g].v.store((uint64_t)a, std::memory_order_seq_cst);
+          int a2 = active.load(std::memory_order_seq_cst);
+          if (a2 != a) {  // lost a race with a flip: re-pin
+            a = a2;
+            pins[g].v.store((uint64_t)a, std::memory_order_seq_cst);
+          }
+          const uint64_t *t = tbl[a].data();
+          for (int j = 0; j < nrd; j++) {
+            uint64_t key = (uint64_t)rkeys[j];
+            uint64_t tag = (key + 1) << 32;
+            uint64_t h = key * 0x9e3779b97f4a7c15ull;
+            h ^= h >> 29;
+            sink = -1;
+            for (uint64_t probe = 0;; probe++) {
+              uint64_t cur = t[(h + probe) & mask];
+              if ((cur & ~0xffffffffull) == tag) {
+                sink = (int64_t)(cur & 0xffffffff);
+                break;
+              }
+              if (cur == 0) break;  // empty slot ends the chain
+            }
+          }
+          pins[g].v.store((uint64_t)-1, std::memory_order_release);
+          done += nrd;
+        }
+        if (!wbuf.empty()) {
+          std::lock_guard<std::mutex> lk(wmu);
+          int a = active.load(std::memory_order_relaxed);
+          auto apply = [&](std::vector<uint64_t> &t) {
+            for (auto &kv : wbuf) {
+              uint64_t key = (uint64_t)kv.first;
+              uint64_t tag = (key + 1) << 32;
+              uint64_t h = key * 0x9e3779b97f4a7c15ull;
+              h ^= h >> 29;
+              uint64_t packed = tag | (uint32_t)kv.second;
+              for (uint64_t probe = 0;; probe++) {
+                uint64_t &slot = t[(h + probe) & mask];
+                if (slot == 0 || (slot & ~0xffffffffull) == tag) {
+                  slot = packed;
+                  break;
+                }
+              }
+            }
+          };
+          apply(tbl[1 - a]);
+          active.store(1 - a, std::memory_order_seq_cst);
+          // drain readers still pinned to the old copy, then replay the
+          // batch there so the copies reconverge
+          for (int t2 = 0; t2 < n_threads; t2++)
+            while (pins[t2].v.load(std::memory_order_seq_cst) ==
+                   (uint64_t)a)
+              cpu_relax();
+          apply(tbl[a]);
+          done += wbuf.size();
+        }
+      }
+      (void)sink;
+      counts[g] = done;
+    });
+  }
+  go.store(true, std::memory_order_release);
+  std::this_thread::sleep_for(std::chrono::milliseconds(duration_ms));
+  stop.store(true);
+  for (auto &t : ts) t.join();
+  uint64_t total = 0;
+  for (int g = 0; g < n_threads; g++) {
+    total += counts[g];
+    if (out_per_thread) out_per_thread[g] = counts[g];
+  }
+  return total;
+}
+
 // A LOCK-FREE open-addressing concurrent map: the competitive middle the
 // reference's headline graphs lead with (urcu gets within ~2x of NR on
 // read-heavy loads, `benches/hashmap_comparisons.rs:281-435`;

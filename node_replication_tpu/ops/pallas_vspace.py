@@ -120,8 +120,23 @@ def _flat_kernel(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, fr_out, resp_ref,
                    resp_ref, n_pages, max_span, window, rows, span_rows)
 
 
+def _flat_plan_kernel(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, tch_in,
+                      fr_out, tch_out, resp_ref,
+                      *, n_pages: int, max_span: int, window: int,
+                      rows: int, span_rows: int):
+    # plan variant (r5): one canonical replica, plus a TOUCHED plane
+    # marking every page written in-window — the dense delta the vmapped
+    # model-side `window_merge` blends per replica (see
+    # make_pallas_vspace_plan_step)
+    del tch_in  # aliased to tch_out
+    with jax.enable_x64(False):
+        _flat_body(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, fr_out,
+                   resp_ref, n_pages, max_span, window, rows, span_rows,
+                   tch_out=tch_out)
+
+
 def _flat_body(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, fr_out, resp_ref,
-               n_pages, max_span, window, rows, span_rows):
+               n_pages, max_span, window, rows, span_rows, tch_out=None):
     # fr_in is ALIASED to fr_out (input_output_aliases): state lives in
     # one buffer, updated in place — no per-grid-step copy
     del fr_in
@@ -158,20 +173,31 @@ def _flat_body(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, fr_out, resp_ref,
             bits = im * (1 - pres) + (1 - im) * pres
             cnt = _sum32(mask.astype(jnp.int32) * bits)
             newv = jnp.where(is_map, a1 + lane, 0)
-            return cnt, jnp.where(mask[None], newv[None], blk)
+            return cnt, mask, jnp.where(mask[None], newv[None], blk)
 
         # run B: lanes with vm+lane < P (pages [vm, vm+n) direct)
         row0 = jnp.minimum(vm >> 7, jnp.int32(rows - span_rows))
-        c_b, out_b = run(fr_out[:, pl.ds(row0, span_rows), :], row0, vm)
+        c_b, m_b, out_b = run(fr_out[:, pl.ds(row0, span_rows), :],
+                              row0, vm)
         fr_out[:, pl.ds(row0, span_rows), :] = out_b
+        if tch_out is not None:
+            tb = tch_out[:, pl.ds(row0, span_rows), :]
+            tch_out[:, pl.ds(row0, span_rows), :] = jnp.where(
+                m_b[None], jnp.int32(1), tb
+            )
         # run A: wrapped lanes (pages [0, vm+n-P)) — reachable only when
         # the raw vpage was negative (mod wraps the span). Rows start at
         # STATIC 0 (a concrete-constant pl.ds start miscompiles in
         # Mosaic). Run-A rows never overlap run-B's for n_pages >=
         # span_rows*128 + max_span (checked in make_vspace_replay), so
         # the read-after-write is clean.
-        c_a, out_a = run(fr_out[:, :span_rows, :], 0, vm - P)
+        c_a, m_a, out_a = run(fr_out[:, :span_rows, :], 0, vm - P)
         fr_out[:, :span_rows, :] = out_a
+        if tch_out is not None:
+            ta = tch_out[:, :span_rows, :]
+            tch_out[:, :span_rows, :] = jnp.where(
+                m_a[None], jnp.int32(1), ta
+            )
         resp_ref[0, 0, i] = c_b + c_a
         return carry
 
@@ -192,9 +218,33 @@ def _radix_kernel(opc_ref, a0_ref, a1_ref, a2_ref,
                     l2, l3, l4)
 
 
+def _radix_plan_kernel(opc_ref, a0_ref, a1_ref, a2_ref,
+                       pt_in, pd_in, pdpt_in, pml4_in,
+                       wins_in, clr_in, pdt_in,
+                       pt_out, pd_out, pdpt_out, pml4_out, resp_ref,
+                       wins_out, clr_out, pdt_out,
+                       *, n_pages: int, max_span: int, window: int,
+                       rows: int, height: int, l2: int, l3: int,
+                       l4: int):
+    # plan variant (r5): one canonical replica, extended with the dense
+    # delta planes the model-side `window_merge` consumes — WINS (page
+    # written since the last region clear), CLEARED (page's region torn
+    # down in-window), and the per-PD-entry TOUCHED flags. All three
+    # ride the same lane masks as the state blends; the scalar stream is
+    # unchanged except two SMEM flag stores per entry.
+    del wins_in, clr_in, pdt_in  # aliased to their outs
+    with jax.enable_x64(False):
+        _radix_body(opc_ref, a0_ref, a1_ref, a2_ref, pt_in, pd_in,
+                    pdpt_in, pml4_in, pt_out, pd_out, pdpt_out, pml4_out,
+                    resp_ref, n_pages, max_span, window, rows, height,
+                    l2, l3, l4,
+                    plan_refs=(wins_out, clr_out, pdt_out))
+
+
 def _radix_body(opc_ref, a0_ref, a1_ref, a2_ref, pt_in, pd_in, pdpt_in,
                 pml4_in, pt_out, pd_out, pdpt_out, pml4_out, resp_ref,
-                n_pages, max_span, window, rows, height, l2, l3, l4):
+                n_pages, max_span, window, rows, height, l2, l3, l4,
+                plan_refs=None):
     # pt_in is ALIASED to pt_out (per-grid-step replica blocks, so the
     # alias is safe); pd is the grid-invariant SHARED copy and must be
     # reset from its (unaliased) input at every grid step — later grid
@@ -264,6 +314,18 @@ def _radix_body(opc_ref, a0_ref, a1_ref, a2_ref, pt_in, pd_in, pdpt_in,
         out = jnp.where(mask_span[None], newv[None], blk)
         out = jnp.where(mask_tbl[None], 0, out)
         pt_out[:, pl.ds(row0, H), :] = out
+        if plan_refs is not None:
+            wins_out, clr_out, _pdt = plan_refs
+            # wins: written-since-last-clear — map/unmap lanes set, a
+            # region teardown resets its pages
+            wblk = wins_out[:, pl.ds(row0, H), :]
+            wnew = jnp.where(mask_span[None], jnp.int32(1), wblk)
+            wnew = jnp.where(mask_tbl[None], jnp.int32(0), wnew)
+            wins_out[:, pl.ds(row0, H), :] = wnew
+            cblk = clr_out[:, pl.ds(row0, H), :]
+            clr_out[:, pl.ds(row0, H), :] = jnp.where(
+                mask_tbl[None], jnp.int32(1), cblk
+            )
         # ---- level updates (mirrors _mark_levels + teardown) ---------
         live = is_map & (n > 0)
         last = jnp.maximum(vs + n - 1, vs)
@@ -273,6 +335,12 @@ def _radix_body(opc_ref, a0_ref, a1_ref, a2_ref, pt_in, pd_in, pdpt_in,
         value1 = jnp.where(ok1, 1, jnp.where(r1 == r0, value0, pd1))
         pd_out[0, 0, r0] = value0
         pd_out[0, 0, r1] = value1
+        if plan_refs is not None:
+            _pdt = plan_refs[2]
+            # touched = a real update landed (mark under ok0/ok1, clear
+            # under is_tbl); passthrough writes don't count
+            _pdt[0, 0, r0] = jnp.where(ok0 | is_tbl, 1, _pdt[0, 0, r0])
+            _pdt[0, 0, r1] = jnp.where(ok1, 1, _pdt[0, 0, r1])
         h0 = vs >> 18
         hl = last >> 18
         new_pdpt = tuple(
@@ -419,6 +487,245 @@ def make_vspace_replay(
                 resps.reshape(window))
 
     return replay
+
+
+def make_vspace_plan_replay(
+    n_pages: int,
+    window: int,
+    max_span: int,
+    radix: bool,
+    interpret: bool = False,
+):
+    """Canonical-replica PLAN kernel: the span kernel run with R=1,
+    extended to emit the dense in-window delta planes `window_merge`
+    consumes (see `make_pallas_vspace_plan_step`).
+
+    flat:  `plan_replay(opc[W], args[W,3], frames[1,ROWS,128],
+            tch[1,ROWS,128]) -> (frames, tch, resps[W])`
+    radix: `plan_replay(opc, args, pt[1,ROWS,128], pd[l2], pdpt[l3],
+            pml4[l4], wins[1,ROWS,128], clr[1,ROWS,128], pdt[l2])
+            -> (pt, pd, pdpt, pml4, wins, clr, pdt, resps[W])`
+
+    All planes are carried across chunk calls, so a step's chunks
+    compose: a later chunk's region clear resets earlier chunks' wins.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    if max_span > 512:
+        raise ValueError("max_span > 512 breaks the 2-entry/level "
+                         "invariant of the radix walk kernel")
+    what = "radix vspace plan" if radix else "flat vspace plan"
+    rows, _ = _grid_layout(n_pages, 1, interpret, what)
+    span_rows = min(-(-max_span // 128) + 1, rows)
+    if not radix and n_pages < span_rows * 128 + max_span:
+        raise ValueError(
+            f"flat vspace plan replay needs n_pages >= "
+            f"{span_rows * 128 + max_span}; use the combined engine for "
+            f"n_pages={n_pages}"
+        )
+    grid = (1,)
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    plane = pl.BlockSpec((1, rows, 128), lambda i: (0, 0, 0))
+    shared = lambda width: pl.BlockSpec(
+        (1, 1, width), lambda i: (0, 0, 0), memory_space=pltpu.SMEM)
+    pshape = jax.ShapeDtypeStruct((1, rows, 128), jnp.int32)
+
+    if not radix:
+        kernel = functools.partial(
+            _flat_plan_kernel, n_pages=n_pages, max_span=max_span,
+            window=window, rows=rows, span_rows=span_rows,
+        )
+        call = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[smem(), smem(), smem(), smem(), plane, plane],
+            out_specs=[plane, plane, shared(window)],
+            out_shape=[
+                pshape, pshape,
+                jax.ShapeDtypeStruct((1, 1, window), jnp.int32),
+            ],
+            input_output_aliases={4: 0, 5: 1},
+            interpret=interpret,
+        )
+
+        def plan_replay(opc, args, frames, tch):
+            with jax.enable_x64(False):
+                frames, tch, resps = call(
+                    opc, args[:, 0], args[:, 1], args[:, 2], frames, tch
+                )
+            return frames, tch, resps.reshape(window)
+
+        return plan_replay
+
+    l2, l3, l4 = _levels(n_pages)
+    height = max(span_rows, 4)
+    kernel = functools.partial(
+        _radix_plan_kernel, n_pages=n_pages, max_span=max_span,
+        window=window, rows=rows, height=height, l2=l2, l3=l3, l4=l4,
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[smem(), smem(), smem(), smem(), plane,
+                  shared(l2), shared(l3), shared(l4),
+                  plane, plane, shared(l2)],
+        out_specs=[plane, shared(l2), shared(l3), shared(l4),
+                   shared(window), plane, plane, shared(l2)],
+        out_shape=[
+            pshape,
+            jax.ShapeDtypeStruct((1, 1, l2), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1, l3), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1, l4), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1, window), jnp.int32),
+            pshape, pshape,
+            jax.ShapeDtypeStruct((1, 1, l2), jnp.int32),
+        ],
+        input_output_aliases={4: 0, 8: 5, 9: 6, 10: 7},
+        interpret=interpret,
+    )
+
+    def plan_replay(opc, args, pt, pd, pdpt, pml4, wins, clr, pdt):
+        with jax.enable_x64(False):
+            pt, pd, pdpt, pml4, resps, wins, clr, pdt = call(
+                opc, args[:, 0], args[:, 1], args[:, 2], pt,
+                pd.reshape(1, 1, l2), pdpt.reshape(1, 1, l3),
+                pml4.reshape(1, 1, l4), wins, clr,
+                pdt.reshape(1, 1, l2),
+            )
+        return (pt, pd.reshape(l2), pdpt.reshape(l3), pml4.reshape(l4),
+                wins, clr, pdt.reshape(l2), resps.reshape(window))
+
+    return plan_replay
+
+
+def make_pallas_vspace_plan_step(
+    n_pages: int,
+    spec: LogSpec,
+    writes_per_replica: int,
+    reads_per_replica: int,
+    max_span: int,
+    radix: bool,
+    dispatch,
+    interpret: bool = False,
+    jit: bool = True,
+    donate: bool = True,
+):
+    """Pallas-PLANNED combined step: the fleet-scale vspace engine (r5).
+
+    The window's sequential semantics run ONCE, on a single canonical
+    replica, inside the span kernel (bit-exact, fixed-size chunks so
+    compile cost is window-independent); the kernel additionally emits
+    the dense in-window delta planes, from which the model's own
+    `window_merge` does the honest per-replica dense replay work —
+    vmapped over the fleet in MODEL layout, pure HBM-bound blends.
+
+    Why this is the scaling engine: step time ≈ span x ~1.2 µs (the
+    kernel's Mosaic scalar stream, R-independent) + R x O(P/HBM-BW)
+    merge, so fleet throughput grows ~linearly with R, where the classic
+    grouped kernel is capped at G/450 ns by VMEM (G replicas per grid
+    step) and the XLA plan pays ~19 µs/entry in sort/scatter passes
+    whose COMPILE time also grows with the window
+    (BENCH_NOTES r5). Same lock-step precondition as `core/step`'s
+    plan/merge path; differential suite:
+    tests/test_pallas_vspace.py::TestPlanStep.
+    """
+    from node_replication_tpu.ops.encoding import dispatch_reads
+
+    R = spec.n_replicas
+    Bw = int(writes_per_replica)
+    span = R * Bw
+    chunk = span
+    while chunk > 4096 and chunk % 2 == 0:
+        chunk //= 2
+    replay = make_vspace_plan_replay(
+        n_pages, chunk, max_span, radix, interpret=interpret
+    )
+    rows, _ = _grid_layout(n_pages, 1, interpret,
+                           "vspace plan (layout)")
+    P = n_pages
+
+    def to_plane(flat, dtype=jnp.int32):
+        padded = jnp.zeros((rows * 128,), dtype).at[:P].set(
+            flat.astype(dtype)
+        )
+        return padded.reshape(1, rows, 128)
+
+    def from_plane(plane):
+        return plane.reshape(-1)[:P]
+
+    def step(log, states, wr_opcodes, wr_args, rd_opcodes, rd_args):
+        opc = wr_opcodes.reshape(span)
+        args = wr_args.reshape(span, spec.arg_width)
+        log = log_append(spec, log, opc, args, span)
+        # distinct allocations: wins/clr are separately aliased kernel
+        # in/outs and must not share one buffer
+        zero_plane = lambda: jnp.zeros((1, rows, 128), jnp.int32)
+        resp_chunks = []
+        if radix:
+            l2 = states["pd"].shape[-1]
+            pt = to_plane(states["pt"][0])
+            pd = states["pd"][0].astype(jnp.int32)
+            pdpt0 = states["pdpt"][0]
+            pml40 = states["pml4"][0]
+            pdpt = pdpt0.astype(jnp.int32)
+            pml4 = pml40.astype(jnp.int32)
+            wins, clr = zero_plane(), zero_plane()
+            pdt = jnp.zeros((l2,), jnp.int32)
+            for c0 in range(0, span, chunk):
+                pt, pd, pdpt, pml4, wins, clr, pdt, r = replay(
+                    opc[c0:c0 + chunk], args[c0:c0 + chunk], pt, pd,
+                    pdpt, pml4, wins, clr, pdt,
+                )
+                resp_chunks.append(r)
+            plan = {
+                "pt_wins": from_plane(wins) > 0,
+                "pt_value": from_plane(pt),
+                "pt_cleared": from_plane(clr) > 0,
+                "pd_touched": pdt > 0,
+                "pd_value": pd > 0,
+                # monotone levels: in-window first-sets = final & ~init
+                "pdpt_set": (pdpt > 0) & ~pdpt0,
+                "pml4_set": (pml4 > 0) & ~pml40,
+                "resps": (
+                    jnp.concatenate(resp_chunks)
+                    if len(resp_chunks) > 1 else resp_chunks[0]
+                ),
+            }
+        else:
+            frames = to_plane(states["frames"][0])
+            tch = zero_plane()
+            for c0 in range(0, span, chunk):
+                frames, tch, r = replay(
+                    opc[c0:c0 + chunk], args[c0:c0 + chunk], frames, tch
+                )
+                resp_chunks.append(r)
+            plan = {
+                "touched": from_plane(tch) > 0,
+                "value": from_plane(frames),
+                "resps": (
+                    jnp.concatenate(resp_chunks)
+                    if len(resp_chunks) > 1 else resp_chunks[0]
+                ),
+            }
+        # honest per-replica dense replay: the model's own merge blends
+        # the plan against every replica's own tables
+        states, resps = jax.vmap(
+            lambda s: dispatch.window_merge(s, plan)
+        )(states)
+        log = log._replace(
+            ltails=jnp.broadcast_to(log.tail, (R,)), ctail=log.tail,
+            head=log.tail,
+        )
+        own = jnp.arange(R, dtype=jnp.int32)[:, None] * Bw + jnp.arange(
+            Bw, dtype=jnp.int32
+        )[None, :]
+        wr_resps = jnp.take_along_axis(resps, own, axis=1)
+        rd_resps = dispatch_reads(dispatch, states, rd_opcodes, rd_args)
+        return log, states, wr_resps, rd_resps
+
+    if jit:
+        step = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    return step
 
 
 # ------------------------------------------------- state converters

@@ -611,6 +611,28 @@ class TestComparisonBaselines:
         mx, _ = bench_cmp("mutex", 4, 0, 4096, duration_ms=300)
         assert lf > 1.5 * mx, (lf, mx)
 
+    def test_cmp_evmap_runs_and_dominates_reads(self):
+        # the read-optimized class (left-right map): wait-free epoch-
+        # pinned reads must beat the mutex map on a 100%-read mix
+        from node_replication_tpu.native import bench_cmp
+
+        t_ev, per = bench_cmp("evmap", 4, 0, 4096, 32, 200, 7)
+        t_mu, _ = bench_cmp("mutex", 4, 0, 4096, 32, 200, 7)
+        assert t_ev > 0 and len(per) == 4
+        assert t_ev > t_mu
+        # and it survives a write-heavy mix without deadlocking the
+        # flip/drain protocol
+        t_wr, _ = bench_cmp("evmap", 4, 80, 4096, 32, 100, 7)
+        assert t_wr > 0
+
+    def test_cmp_evmap_oversized_keyspace_rejected(self):
+        import pytest
+
+        from node_replication_tpu.native import bench_cmp
+
+        with pytest.raises(ValueError):
+            bench_cmp("evmap", 2, 0, 1 << 27, 32, 50, 1)
+
     def test_cmp_unknown_system_rejected(self):
         import pytest
 

@@ -161,6 +161,118 @@ class TestPallasVspaceStep:
             assert int(getattr(log_a, name)) == int(getattr(log_b, name))
 
 
+class TestPlanStep:
+    """Pallas-planned step (r5): canonical-replica kernel plan + vmapped
+    model-side window_merge. Bit-exact vs the generic scan step across
+    multi-step drives — states, write resps, read resps, cursors."""
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_radix_plan_step_matches_scan_step(self, seed):
+        from node_replication_tpu.ops.pallas_vspace import (
+            make_pallas_vspace_plan_step,
+        )
+
+        R, Bw, Br, P, S, STEPS = 3, 4, 2, 1100, 8, 4
+        d = make_vspace_radix(P, max_span=S)
+        spec = LogSpec(capacity=1 << 10, n_replicas=R, gc_slack=32)
+        rng = np.random.default_rng(seed)
+        scan_step = make_step(d, spec, Bw, Br, jit=False, combined=False)
+        plan_step = make_pallas_vspace_plan_step(
+            P, spec, Bw, Br, S, radix=True, dispatch=d, interpret=True,
+            jit=False,
+        )
+        log_a, st_a = log_init(spec), replicate_state(d.init_state(), R)
+        log_b, st_b = log_init(spec), replicate_state(d.init_state(), R)
+        for _ in range(STEPS):
+            wr_opc = jnp.asarray(
+                rng.choice([0, 1, 2, 3, 4], size=(R, Bw)), jnp.int32
+            )
+            wr_args = jnp.asarray(
+                np.stack([rng.integers(0, P, (R, Bw)),
+                          rng.integers(0, 60, (R, Bw)),
+                          rng.integers(0, S + 1, (R, Bw))], axis=-1),
+                jnp.int32,
+            )
+            rd_opc = jnp.asarray(
+                rng.choice([1, 2, 3], size=(R, Br)), jnp.int32
+            )
+            rd_args = jnp.asarray(
+                np.stack([rng.integers(0, P, (R, Br)),
+                          rng.integers(1, 9, (R, Br)),
+                          np.zeros((R, Br))], axis=-1),
+                jnp.int32,
+            )
+            log_a, st_a, wr_a, rd_a = scan_step(
+                log_a, st_a, wr_opc, wr_args, rd_opc, rd_args
+            )
+            log_b, st_b, wr_b, rd_b = plan_step(
+                log_b, st_b, wr_opc, wr_args, rd_opc, rd_args
+            )
+            np.testing.assert_array_equal(np.asarray(wr_a),
+                                          np.asarray(wr_b))
+            np.testing.assert_array_equal(np.asarray(rd_a),
+                                          np.asarray(rd_b))
+        for k in ("pt", "pd", "pdpt", "pml4"):
+            np.testing.assert_array_equal(
+                np.asarray(st_b[k]), np.asarray(st_a[k]), k
+            )
+        for name in ("tail", "ctail", "head"):
+            assert int(getattr(log_a, name)) == int(getattr(log_b, name))
+        np.testing.assert_array_equal(
+            np.asarray(log_a.ltails), np.asarray(log_b.ltails)
+        )
+
+    def test_flat_plan_step_matches_scan_step(self):
+        from node_replication_tpu.models import make_vspace
+        from node_replication_tpu.ops.pallas_vspace import (
+            make_pallas_vspace_plan_step,
+        )
+
+        R, Bw, Br, P, S, STEPS = 2, 4, 2, 1024, 8, 4
+        d = make_vspace(P, max_span=S)
+        spec = LogSpec(capacity=1 << 10, n_replicas=R, gc_slack=32)
+        rng = np.random.default_rng(3)
+        scan_step = make_step(d, spec, Bw, Br, jit=False, combined=False)
+        plan_step = make_pallas_vspace_plan_step(
+            P, spec, Bw, Br, S, radix=False, dispatch=d, interpret=True,
+            jit=False,
+        )
+        log_a, st_a = log_init(spec), replicate_state(d.init_state(), R)
+        log_b, st_b = log_init(spec), replicate_state(d.init_state(), R)
+        for _ in range(STEPS):
+            wr_opc = jnp.asarray(
+                rng.choice([0, 1, 2], size=(R, Bw)), jnp.int32
+            )
+            wr_args = jnp.asarray(
+                np.stack([rng.integers(-3, P, (R, Bw)),
+                          rng.integers(0, 60, (R, Bw)),
+                          rng.integers(0, S + 1, (R, Bw))], axis=-1),
+                jnp.int32,
+            )
+            rd_opc = jnp.asarray(
+                rng.choice([1, 2], size=(R, Br)), jnp.int32
+            )
+            rd_args = jnp.asarray(
+                np.stack([rng.integers(0, P, (R, Br)),
+                          rng.integers(1, 9, (R, Br)),
+                          np.zeros((R, Br))], axis=-1),
+                jnp.int32,
+            )
+            log_a, st_a, wr_a, rd_a = scan_step(
+                log_a, st_a, wr_opc, wr_args, rd_opc, rd_args
+            )
+            log_b, st_b, wr_b, rd_b = plan_step(
+                log_b, st_b, wr_opc, wr_args, rd_opc, rd_args
+            )
+            np.testing.assert_array_equal(np.asarray(wr_a),
+                                          np.asarray(wr_b))
+            np.testing.assert_array_equal(np.asarray(rd_a),
+                                          np.asarray(rd_b))
+        np.testing.assert_array_equal(
+            np.asarray(st_b["frames"]), np.asarray(st_a["frames"])
+        )
+
+
 @pytest.mark.skipif(
     not os.environ.get("NR_TPU_SMOKE"),
     reason="hardware smoke (set NR_TPU_SMOKE=1 on a real TPU). Proven r4 "
