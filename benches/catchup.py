@@ -79,9 +79,15 @@ def main():
                        ("combined", log_catchup_all)):
         win = (args.scan_window or args.window) if engine == "scan" \
             else args.window
-        # no donation: inputs are reused for warmup then the timed run
+        # no donation: inputs are reused for warmup then the timed run.
+        # Recovery semantics: no response consumers (need_resps=False on
+        # the combined engine; the scan computes them inline anyway)
         step = jax.jit(
-            lambda lg, st, fn=fn, win=win: fn(spec, d, lg, st, win)
+            lambda lg, st, fn=fn, win=win: (
+                fn(spec, d, lg, st, win, need_resps=False)
+                if fn is log_catchup_all
+                else fn(spec, d, lg, st, win)
+            )
         )
         log0 = log_init(spec)
         log0 = log_append(spec, log0, opc, ag, W)

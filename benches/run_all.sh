@@ -12,12 +12,18 @@ DUR=${DUR:-1.0}
 python hashmap.py --replicas 4 16 --write-ratios 0 10 50 100 \
   --duration "$DUR" --out-dir "$OUT" $EXTRA
 python hashmap.py --baseline --duration "$DUR" --out-dir "$OUT" $EXTRA
-python stack.py --replicas 4 16 --duration "$DUR" $EXTRA
+python stack.py --replicas 4 16 --duration "$DUR" --out-dir "$OUT" $EXTRA
+python stack.py --queue --replicas 4 16 --duration "$DUR" \
+  --out-dir "$OUT" $EXTRA
+python catchup.py --replicas 8 --pending 2048 --window 512 \
+  --out-dir "$OUT" $EXTRA
 python synthetic.py --replicas 4 --duration "$DUR" --out-dir "$OUT" $EXTRA
-python vspace.py --replicas 4 --duration "$DUR" $EXTRA
-python vspace.py --long-log --replicas 4 --duration "$DUR" $EXTRA
-python memfs.py --replicas 4 --duration "$DUR" $EXTRA
-python nrfs.py --replicas 4 --logs 1 4 --duration "$DUR" $EXTRA
+python vspace.py --replicas 4 --duration "$DUR" --out-dir "$OUT" $EXTRA
+python vspace.py --long-log --replicas 4 --duration "$DUR" \
+  --out-dir "$OUT" $EXTRA
+python memfs.py --replicas 4 --duration "$DUR" --out-dir "$OUT" $EXTRA
+python nrfs.py --replicas 4 --logs 1 4 --duration "$DUR" \
+  --out-dir "$OUT" $EXTRA
 python lockfree.py --replicas 4 --logs 1 4 --duration "$DUR" \
   --out-dir "$OUT" $EXTRA
 python log.py --duration "$DUR" $EXTRA

@@ -133,11 +133,13 @@ def recover_states(
         ltails=jnp.full((spec.n_replicas,), start, jnp.int64)
     )
     # Combined catch-up (`log_catchup_all`): recovery replays at
-    # window_apply speed when the model provides it, scan otherwise —
-    # the reference recovers through the same hot exec loop it always
-    # runs (`nr/src/log.rs:473-524`), and so does this.
+    # combined speed when the model provides it, scan otherwise — the
+    # reference recovers through the same hot exec loop it always runs
+    # (`nr/src/log.rs:473-524`), and so does this. Pure recovery has no
+    # response consumers, so skip the O(R x window) response re-index.
     exec_jit = jax.jit(
-        lambda lg, st: log_catchup_all(spec, dispatch, lg, st, window)
+        lambda lg, st: log_catchup_all(spec, dispatch, lg, st, window,
+                                       need_resps=False)
     )
     states = base_states
     while int(jnp.min(log.ltails)) < int(log.tail):

@@ -269,25 +269,58 @@ def log_catchup_all(
     states: PyTree,
     window: int,
     limits: jax.Array | None = None,
+    need_resps: bool = True,
 ):
-    """Combined catch-up: `log_exec_all` semantics at `window_apply` speed.
+    """Combined catch-up: `log_exec_all` semantics at combined speed.
+
+    `need_resps=False` (pure recovery: checkpoint replay, crash
+    rebuild, the catch-up bench) skips the per-replica response
+    re-indexing — on the union-plan path that is an O(R x window)
+    random gather that dominates fleet-scale rounds (measured 840 ms of
+    an 874 ms round at R=4096) — and returns zeros; the reference's
+    catch-up likewise applies other replicas' entries without
+    delivering their responses (`nr/src/log.rs:473-524` hands resps
+    only to the calling combiner's own batch).
 
     In the reference, catch-up IS the hot loop — a lagging replica replays
     through the same `exec` everyone uses (`nr/src/log.rs:473-524`). The
-    fused step's plan/merge split can't serve that role here (it needs the
-    lock-step precondition, `core/step.py`), but `window_apply` works on
-    ARBITRARY per-replica state: each replica gathers its own
-    `[ltails[r], min(tail, ltails[r]+window))` window from the ring
-    (positions past its effective tail masked to NOOP by `gather_window`)
-    and applies it as one combined reduction instead of a `window`-long
-    sequential scan. Same cursor lattice updates, same response layout
-    (`resps[r, i]` answers position `old_ltails[r] + i`), bit-identical
-    states — differentially tested in `tests/test_window.py`.
+    fused step's plan/merge split can't serve that role directly (it
+    needs the lock-step precondition, `core/step.py`), so this runs one
+    of three engines, fastest applicable first:
 
-    Falls back to `log_exec_all` when the model has no `window_apply`
-    (plan/merge-only models use their `window_apply` form, which all
-    bundled models provide alongside the split).
+    1. **union-window plan** (model provides `window_plan`/`window_merge`
+       and no `limits`): every replica of a log-driven fleet lies on the
+       SAME replay trajectory — `states[r]` is the fold of
+       `[0, ltails[r])` from common init — so the plan of the union
+       window `[min(ltails), min(ltails)+window)`, computed ONCE from the
+       most-lagging replica's state, merges correctly into every replica
+       inside the window: cells the window touches take the plan's final
+       value (identical no matter how much of the window a replica
+       already applied — deterministic replay), untouched cells keep the
+       replica's own (already-canonical) value. Replicas past the window
+       end are left untouched. ONE sort serves the fleet — the same
+       economics as the lock-step fast path, now for divergent cursors.
+       NOT valid for hand-built fleets with off-trajectory states; those
+       use `window_apply` (`combined=...` paths) or the scan.
+    2. **per-replica `window_apply`** (arbitrary state; also the `limits`
+       path — a limit truncates a replica's window individually, so no
+       shared plan exists): each replica gathers and combines its own
+       window; pays R sorts.
+    3. **`log_exec_all` scan** when the model has no combined form.
+
+    Cursor lattice updates match `log_exec_all` except that the
+    union-window engine advances every lagging replica to the SAME
+    position (the window end) — a faster join of the same lattice.
+    Response layout is preserved: `resps[r, i]` answers logical position
+    `old_ltails[r] + i` (0 past the replica's advancement), which is
+    exactly what response delivery consumes. Differentially tested in
+    `tests/test_window.py::TestCombinedCatchup`.
     """
+    if d.window_apply is None and d.window_plan is None:
+        return log_exec_all(spec, d, log, states, window, limits)
+    if d.window_plan is not None and limits is None:
+        return _catchup_union_plan(spec, d, log, states, window,
+                                   need_resps)
     if d.window_apply is None:
         return log_exec_all(spec, d, log, states, window, limits)
 
@@ -318,6 +351,75 @@ def log_catchup_all(
         states, resps, new_ltails = jax.vmap(one)(
             states, log.ltails, jnp.asarray(limits, jnp.int64)
         )
+    log = log._replace(
+        ltails=new_ltails,
+        ctail=jnp.maximum(log.ctail, jnp.max(new_ltails)),
+        head=jnp.min(new_ltails),
+    )
+    return log, states, resps
+
+
+def _catchup_union_plan(
+    spec: LogSpec,
+    d: Dispatch,
+    log: LogState,
+    states: PyTree,
+    window: int,
+    need_resps: bool = True,
+):
+    """Union-window catch-up (see `log_catchup_all` engine 1).
+
+    Soundness: with deterministic replay from common init, `states[r]`
+    is the fold of `[0, ltails[r])`, so for any position p in
+    `[m, end]`, `window_merge(state(p), window_plan(state(m), W_m))`
+    equals `state(end)` — cells the window `W_m = [m, end)` touches take
+    the plan's final value (independent of how much of `W_m` the replica
+    already applied: replay of the shared log is deterministic, so the
+    replica's own application of a prefix wrote exactly the values the
+    plan's events record), untouched cells keep the replica's value,
+    which equals the canonical one. Replicas whose cursor is PAST the
+    window end must not merge (the plan's final values could rewind
+    them); they are masked out and keep their state and cursor.
+    """
+    m = jnp.min(log.ltails)
+    end = jnp.minimum(m + window, log.tail)
+    check(m >= log.head,
+          "catch-up window starts at {m}, behind GC head {h}: entries "
+          "already overwritten",
+          m=m, h=log.head)
+    check(jnp.max(log.ltails) <= log.tail,
+          "replica ltail {lt} ahead of log tail {t}",
+          lt=jnp.max(log.ltails), t=log.tail)
+    opcodes, args = gather_window(
+        spec, log.opcodes, log.args, m, end, window
+    )
+    donor = jnp.argmin(log.ltails)
+    donor_state = jax.tree.map(lambda x: x[donor], states)
+    plan = d.window_plan(donor_state, opcodes, args)
+    merged, presps = jax.vmap(lambda s: d.window_merge(s, plan))(states)
+    take = log.ltails < end
+    states = jax.tree.map(
+        lambda a, b: jnp.where(
+            take.reshape((-1,) + (1,) * (a.ndim - 1)), b, a
+        ),
+        states, merged,
+    )
+    if need_resps:
+        # response layout contract: resps[r, i] answers logical position
+        # old_ltails[r] + i — gathered from the canonical per-position
+        # plan responses; positions at/past the replica's new cursor are
+        # 0 (never consumed by delivery)
+        offs = (log.ltails - m)[:, None] + jnp.arange(
+            window, dtype=jnp.int64
+        )[None, :]
+        resps = jnp.take_along_axis(
+            presps, jnp.clip(offs, 0, window - 1).astype(jnp.int32),
+            axis=1,
+        )
+        resps = jnp.where(offs < (end - m), resps, 0)
+    else:
+        resps = jnp.zeros_like(presps)
+    new_ltails = jnp.maximum(log.ltails, end)
     log = log._replace(
         ltails=new_ltails,
         ctail=jnp.maximum(log.ctail, jnp.max(new_ltails)),

@@ -68,7 +68,7 @@ def make_hashmap(n_keys: int, prefill_value: int | None = None) -> Dispatch:
             state["present"][k], state["values"][k], jnp.int32(ABSENT)
         )
 
-    def window_apply(state, opcodes, args):
+    def window_plan(state, opcodes, args):
         """Combined replay of a whole window (see `Dispatch.window_apply`).
 
         PUT/REMOVE are last-writer-wins per key, so the final state needs
@@ -85,6 +85,11 @@ def make_hashmap(n_keys: int, prefill_value: int | None = None) -> Dispatch:
         (differentially tested in tests/test_window.py). Replaces the
         reference's per-entry replay loop (`nr/src/log.rs:473-524`) with
         O(W log W) parallel work instead of W sequential scatters.
+
+        Packaged as plan/merge (r5): the sort half runs once per window
+        (fused step AND union-window catch-up — the plan is
+        prefix-absorbing: per-key finals are absolute); the vmapped
+        merge is the honest per-replica dense blend.
         """
         W = opcodes.shape[0]
         k = args[:, 0] % n_keys
@@ -126,11 +131,25 @@ def make_hashmap(n_keys: int, prefill_value: int | None = None) -> Dispatch:
         touched = last >= 0
         li = jnp.clip(last, 0).astype(jnp.int32)
         last_is_put = is_put[li]
-        values = jnp.where(
-            touched, jnp.where(last_is_put, v[li], 0), state["values"]
-        )
-        present = jnp.where(touched, last_is_put, state["present"])
-        return {"values": values, "present": present}, resps
+        return {
+            "touched": touched,
+            "value": jnp.where(last_is_put, v[li], 0),
+            "present": last_is_put,
+            "resps": resps,
+        }
+
+    def window_merge(state, plan):
+        return {
+            "values": jnp.where(plan["touched"], plan["value"],
+                                state["values"]),
+            "present": jnp.where(plan["touched"], plan["present"],
+                                 state["present"]),
+        }, plan["resps"]
+
+    def window_apply(state, opcodes, args):
+        # arbitrary-state form: the plan's presence-before half reads
+        # THIS state, so the composition is the full per-replica fold
+        return window_merge(state, window_plan(state, opcodes, args))
 
     return Dispatch(
         name=f"hashmap{n_keys}",
@@ -139,4 +158,6 @@ def make_hashmap(n_keys: int, prefill_value: int | None = None) -> Dispatch:
         read_ops=(get,),
         arg_width=3,
         window_apply=window_apply,
+        window_plan=window_plan,
+        window_merge=window_merge,
     )

@@ -125,18 +125,25 @@ def make_queue(capacity: int) -> Dispatch:
         ).astype(jnp.int32)
         touched, lastv = last_update_table(slot_upd, v, capacity)
         W = opcodes.shape[0]
+        enq_total = enq_sum[W - 1] if W > 0 else jnp.int32(0)
+        deq_total = deq_sum[W - 1] if W > 0 else jnp.int32(0)
         return {
             "touched": touched, "lastv": lastv, "resps": resps,
-            "enq_total": enq_sum[W - 1] if W > 0 else jnp.int32(0),
-            "deq_total": deq_sum[W - 1] if W > 0 else jnp.int32(0),
+            # ABSOLUTE final cursors (not deltas): under lock-step this
+            # is identical to state + delta, and it makes the plan
+            # prefix-absorbing — merging it into a replica that already
+            # applied a window prefix (`log_catchup_all`'s union-window
+            # engine) must not double-count the prefix's cursor moves
+            "head_final": (state["head"] + deq_total).astype(jnp.int32),
+            "tail_final": (state["tail"] + enq_total).astype(jnp.int32),
         }
 
     def window_merge(state, plan):
         buf = jnp.where(plan["touched"], plan["lastv"], state["buf"])
         return {
             "buf": buf,
-            "head": (state["head"] + plan["deq_total"]).astype(jnp.int32),
-            "tail": (state["tail"] + plan["enq_total"]).astype(jnp.int32),
+            "head": plan["head_final"],
+            "tail": plan["tail_final"],
         }, plan["resps"]
 
     return Dispatch(

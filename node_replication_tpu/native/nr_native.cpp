@@ -1260,11 +1260,15 @@ uint64_t nr_bench_cmp_evmap(int n_threads, int write_pct, int64_t keyspace,
         if (nrd > 0) {
           // pin the active copy once per read batch (seq_cst on the
           // pin/check pair: the writer's flip-then-scan must not pass
-          // our pin-then-read on non-TSO targets)
+          // our pin-then-read on non-TSO targets). Pin-then-VERIFY must
+          // LOOP: each lost race re-pins, and only an unchanged
+          // re-read of `active` proves the writer's drain will see this
+          // pin before replaying onto the pinned copy.
           int a = active.load(std::memory_order_seq_cst);
           pins[g].v.store((uint64_t)a, std::memory_order_seq_cst);
-          int a2 = active.load(std::memory_order_seq_cst);
-          if (a2 != a) {  // lost a race with a flip: re-pin
+          for (;;) {
+            int a2 = active.load(std::memory_order_seq_cst);
+            if (a2 == a) break;
             a = a2;
             pins[g].v.store((uint64_t)a, std::memory_order_seq_cst);
           }

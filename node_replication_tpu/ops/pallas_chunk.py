@@ -1,0 +1,62 @@
+"""Bounded-grid chunking shared by the multi-grid-step pallas kernels.
+
+MAX_GRID is an empirical Mosaic limit found in r5 (TPU v5e): a
+pallas_call whose blocked state planes were ALIASED in->out silently
+corrupted state once the grid pipelined deep enough — always at >= 64
+grid steps, occasionally at 32, never in interpret mode (bisected with
+the oahashmap kernel across rows/group/slot-count combinations; the
+corruption was replicas in later grid steps reading stale or shifted
+blocks). The kernels now use separate in/out planes with an in-kernel
+block copy, which removes the observed corruption; the grid cap stays
+as belt and braces, and the replica axis is split into <= MAX_GRID-step
+calls at the XLA level by the helpers here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MAX_GRID = 32
+
+
+def chunk_size(n_replicas: int, group: int) -> int:
+    """Replicas per pallas_call: `group` replicas per grid step, at most
+    MAX_GRID steps."""
+    return min(n_replicas, group * MAX_GRID)
+
+
+def build_calls(n_replicas: int, chunk_r: int, build_call):
+    """One compiled pallas_call per DISTINCT chunk length (the full
+    chunks plus at most one remainder)."""
+    calls = {}
+    for r0 in range(0, n_replicas, chunk_r):
+        sub = min(chunk_r, n_replicas - r0)
+        if sub not in calls:
+            calls[sub] = build_call(sub)
+    return calls
+
+
+def run_chunks(n_replicas: int, chunk_r: int, calls, invoke,
+               n_plane_outs: int):
+    """Map the replica axis through the per-chunk calls.
+
+    `invoke(call, r0, sub)` runs one chunk and returns a tuple whose
+    FIRST `n_plane_outs` entries are replica-axis plane outputs
+    (concatenated across chunks) and whose remaining entries are
+    canonical single copies (every chunk recomputes identical values —
+    the lock-step invariant — so the last chunk's win). Returns
+    `(planes: list, rest: tuple)`.
+    """
+    planes = [[] for _ in range(n_plane_outs)]
+    rest = ()
+    for r0 in range(0, n_replicas, chunk_r):
+        sub = min(chunk_r, n_replicas - r0)
+        out = invoke(calls[sub], r0, sub)
+        for i in range(n_plane_outs):
+            planes[i].append(out[i])
+        rest = tuple(out[n_plane_outs:])
+    cat = [
+        p[0] if len(p) == 1 else jnp.concatenate(p, axis=0)
+        for p in planes
+    ]
+    return cat, rest

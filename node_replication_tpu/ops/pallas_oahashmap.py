@@ -104,8 +104,15 @@ def _oa_kernel(opc_ref, a0_ref, a1_ref,
 
 def _oa_body(opc_ref, a0_ref, a1_ref, k_in, v_in, f_in, k_out, v_out,
              f_out, resp_ref, n_slots, probe, window, rows, span_rows):
-    # all three planes are aliased in->out (in-place state)
-    del k_in, v_in, f_in
+    # UN-aliased in/out (r5): aliased blocked state planes race with the
+    # pipeline's prefetch/writeback on hardware — replicas in later grid
+    # steps read stale or shifted blocks, nondeterministically (bisected
+    # on TPU v5e: ~always corrupt past 32 grid steps, occasionally at
+    # 32). Copy the input block in and work in the output block; only
+    # the grid=1 plan kernels keep in-place aliasing.
+    k_out[...] = k_in[...]
+    v_out[...] = v_in[...]
+    f_out[...] = f_in[...]
     N = jnp.int32(n_slots)
 
     def body(i, carry):
@@ -183,8 +190,9 @@ def _oa_body(opc_ref, a0_ref, a1_ref, k_in, v_in, f_in, k_out, v_out,
 def _layout(n_slots: int, probe: int, n_replicas: int, interpret: bool):
     rows = max(2, _round_up(n_slots, 128) // 128 + 1)  # +1 guard row
     span_rows = min(-(-probe // 128) + 1, rows)
-    # three aliased planes per replica, double-buffered
-    per = 2 * 3 * rows * 128 * 4
+    # three planes per replica, separate in+out blocks (un-aliased),
+    # each double-buffered
+    per = 2 * 2 * 3 * rows * 128 * 4
     if per > _VMEM_BUDGET and not interpret:
         raise ValueError(
             f"oahashmap pallas replay needs {per >> 20} MB of VMEM for "
@@ -220,34 +228,50 @@ def make_oahashmap_replay(
         raise ValueError("probe > 128 breaks the two-run window split")
     rows, span_rows, group = _layout(n_slots, probe, n_replicas,
                                      interpret)
-    grid = (n_replicas // group,)
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
-    plane = pl.BlockSpec((group, rows, 128), lambda i: (i, 0, 0))
-    resp_spec = pl.BlockSpec((1, 1, window), lambda i: (0, 0, 0),
-                             memory_space=pltpu.SMEM)
     kernel = functools.partial(
         _oa_kernel, n_slots=n_slots, probe=probe, window=window,
         rows=rows, span_rows=span_rows,
     )
-    call = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[smem(), smem(), smem(), plane, plane, plane],
-        out_specs=[plane, plane, plane, resp_spec],
-        out_shape=[
-            jax.ShapeDtypeStruct((n_replicas, rows, 128), jnp.int32),
-            jax.ShapeDtypeStruct((n_replicas, rows, 128), jnp.int32),
-            jax.ShapeDtypeStruct((n_replicas, rows, 128), jnp.int32),
-            jax.ShapeDtypeStruct((1, 1, window), jnp.int32),
-        ],
-        input_output_aliases={3: 0, 4: 1, 5: 2},
-        interpret=interpret,
+
+    def build_call(sub_r: int):
+        plane = pl.BlockSpec((group, rows, 128), lambda i: (i, 0, 0))
+        resp_spec = pl.BlockSpec((1, 1, window), lambda i: (0, 0, 0),
+                                 memory_space=pltpu.SMEM)
+        return pl.pallas_call(
+            kernel,
+            grid=(sub_r // group,),
+            in_specs=[smem(), smem(), smem(), plane, plane, plane],
+            out_specs=[plane, plane, plane, resp_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((sub_r, rows, 128), jnp.int32),
+                jax.ShapeDtypeStruct((sub_r, rows, 128), jnp.int32),
+                jax.ShapeDtypeStruct((sub_r, rows, 128), jnp.int32),
+                jax.ShapeDtypeStruct((1, 1, window), jnp.int32),
+            ],
+            # NO input_output_aliases: see _oa_body's un-aliased note
+            interpret=interpret,
+        )
+
+    from node_replication_tpu.ops.pallas_chunk import (
+        build_calls,
+        chunk_size,
+        run_chunks,
     )
+
+    chunk_r = chunk_size(n_replicas, group)
+    calls = build_calls(n_replicas, chunk_r, build_call)
 
     def replay(opc, args, keys, vals, flag):
         with jax.enable_x64(False):
-            keys, vals, flag, resps = call(
-                opc, args[:, 0], args[:, 1], keys, vals, flag
+            a0, a1 = args[:, 0], args[:, 1]
+            (keys, vals, flag), (resps,) = run_chunks(
+                n_replicas, chunk_r, calls,
+                lambda call, r0, sub: call(
+                    opc, a0, a1, keys[r0:r0 + sub], vals[r0:r0 + sub],
+                    flag[r0:r0 + sub],
+                ),
+                n_plane_outs=3,
             )
         return keys, vals, flag, resps.reshape(window)
 

@@ -117,7 +117,8 @@ def _flat_kernel(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, fr_out, resp_ref,
     # leak int64 converts into the Mosaic lowering
     with jax.enable_x64(False):
         _flat_body(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, fr_out,
-                   resp_ref, n_pages, max_span, window, rows, span_rows)
+                   resp_ref, n_pages, max_span, window, rows, span_rows,
+                   copy_in=True)
 
 
 def _flat_plan_kernel(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, tch_in,
@@ -136,10 +137,16 @@ def _flat_plan_kernel(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, tch_in,
 
 
 def _flat_body(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, fr_out, resp_ref,
-               n_pages, max_span, window, rows, span_rows, tch_out=None):
-    # fr_in is ALIASED to fr_out (input_output_aliases): state lives in
-    # one buffer, updated in place — no per-grid-step copy
-    del fr_in
+               n_pages, max_span, window, rows, span_rows, tch_out=None,
+               copy_in=False):
+    # copy_in=True: UN-aliased in/out — aliased blocked state races with
+    # the pipeline's prefetch/writeback on hardware past ~32 grid steps
+    # (see ops/pallas_oahashmap._oa_body); the grid=1 plan kernels keep
+    # in-place aliasing (copy_in=False)
+    if copy_in:
+        fr_out[...] = fr_in[...]
+    else:
+        del fr_in
     P = jnp.int32(n_pages)
 
     def body(i, carry):
@@ -215,7 +222,7 @@ def _radix_kernel(opc_ref, a0_ref, a1_ref, a2_ref,
         _radix_body(opc_ref, a0_ref, a1_ref, a2_ref, pt_in, pd_in,
                     pdpt_in, pml4_in, pt_out, pd_out, pdpt_out, pml4_out,
                     resp_ref, n_pages, max_span, window, rows, height,
-                    l2, l3, l4)
+                    l2, l3, l4, copy_in=True)
 
 
 def _radix_plan_kernel(opc_ref, a0_ref, a1_ref, a2_ref,
@@ -244,13 +251,16 @@ def _radix_plan_kernel(opc_ref, a0_ref, a1_ref, a2_ref,
 def _radix_body(opc_ref, a0_ref, a1_ref, a2_ref, pt_in, pd_in, pdpt_in,
                 pml4_in, pt_out, pd_out, pdpt_out, pml4_out, resp_ref,
                 n_pages, max_span, window, rows, height, l2, l3, l4,
-                plan_refs=None):
-    # pt_in is ALIASED to pt_out (per-grid-step replica blocks, so the
-    # alias is safe); pd is the grid-invariant SHARED copy and must be
-    # reset from its (unaliased) input at every grid step — later grid
-    # steps recompute the identical level trajectory so their responses
-    # stay correct
-    del pt_in
+                plan_refs=None, copy_in=False):
+    # copy_in=True: UN-aliased pt in/out (the aliased-block pipeline
+    # race — see _flat_body); the grid=1 plan kernel keeps aliasing.
+    # pd is the grid-invariant SHARED copy and must be reset from its
+    # (unaliased) input at every grid step — later grid steps recompute
+    # the identical level trajectory so their responses stay correct
+    if copy_in:
+        pt_out[...] = pt_in[...]
+    else:
+        del pt_in
     _smem_copy(pd_out, pd_in, l2)
     P = jnp.int32(n_pages)
     H = height
@@ -365,11 +375,15 @@ def _levels(n_pages: int):
 
 
 def _grid_layout(n_pages: int, n_replicas: int, interpret: bool,
-                 what: str):
-    """ROWS (page rows per replica) and G (replicas per grid step)."""
+                 what: str, aliased: bool = False):
+    """ROWS (page rows per replica) and G (replicas per grid step).
+
+    `aliased=True` (the grid=1 plan kernels): one in-place pt buffer.
+    `aliased=False` (multi-grid-step classic kernels): separate in+out
+    blocks (the pipeline race — see _flat_body), each double-buffered.
+    """
     rows = max(4, _round_up(n_pages, 512) // 128)
-    # per replica: ONE aliased pt buffer, double-buffered for pipelining
-    per = 2 * rows * 128 * 4
+    per = (2 if aliased else 4) * rows * 128 * 4
     if per > _VMEM_BUDGET and not interpret:
         raise ValueError(
             f"{what} pallas replay needs {per >> 20} MB of VMEM for "
@@ -417,9 +431,14 @@ def make_vspace_replay(
             f"row blends never overlap; use the combined engine for "
             f"n_pages={n_pages}"
         )
-    grid = (n_replicas // group,)
+    from node_replication_tpu.ops.pallas_chunk import (
+        build_calls,
+        chunk_size,
+        run_chunks,
+    )
+
+    chunk_r = chunk_size(n_replicas, group)
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
-    state_spec = pl.BlockSpec((group, rows, 128), lambda i: (i, 0, 0))
     # single canonical copies: every grid step recomputes the identical
     # values from the identical window (idempotent revisions)
     shared = lambda width: pl.BlockSpec(
@@ -430,23 +449,35 @@ def make_vspace_replay(
             _flat_kernel, n_pages=n_pages, max_span=max_span,
             window=window, rows=rows, span_rows=span_rows,
         )
-        call = pl.pallas_call(
-            kernel,
-            grid=grid,
-            in_specs=[smem(), smem(), smem(), smem(), state_spec],
-            out_specs=[state_spec, shared(window)],
-            out_shape=[
-                jax.ShapeDtypeStruct((n_replicas, rows, 128), jnp.int32),
-                jax.ShapeDtypeStruct((1, 1, window), jnp.int32),
-            ],
-            input_output_aliases={4: 0},
-            interpret=interpret,
-        )
+
+        def build_call(sub_r: int):
+            state_spec = pl.BlockSpec((group, rows, 128),
+                                      lambda i: (i, 0, 0))
+            return pl.pallas_call(
+                kernel,
+                grid=(sub_r // group,),
+                in_specs=[smem(), smem(), smem(), smem(), state_spec],
+                out_specs=[state_spec, shared(window)],
+                out_shape=[
+                    jax.ShapeDtypeStruct((sub_r, rows, 128), jnp.int32),
+                    jax.ShapeDtypeStruct((1, 1, window), jnp.int32),
+                ],
+                # NO aliasing: un-aliased in/out (pipeline race)
+                interpret=interpret,
+            )
+
+        calls = build_calls(n_replicas, chunk_r, build_call)
 
         def replay(opc, args, frames):
             with jax.enable_x64(False):
-                frames, resps = call(opc, args[:, 0], args[:, 1],
-                                     args[:, 2], frames)
+                a0, a1, a2 = args[:, 0], args[:, 1], args[:, 2]
+                (frames,), (resps,) = run_chunks(
+                    n_replicas, chunk_r, calls,
+                    lambda call, r0, sub: call(
+                        opc, a0, a1, a2, frames[r0:r0 + sub]
+                    ),
+                    n_plane_outs=1,
+                )
             return frames, resps.reshape(window)
 
         return replay
@@ -458,33 +489,48 @@ def make_vspace_replay(
         _radix_kernel, n_pages=n_pages, max_span=max_span, window=window,
         rows=rows, height=height, l2=l2, l3=l3, l4=l4,
     )
-    call = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[smem(), smem(), smem(), smem(), state_spec,
-                  shared(l2), shared(l3), shared(l4)],
-        out_specs=[state_spec, shared(l2), shared(l3), shared(l4),
-                   shared(window)],
-        out_shape=[
-            jax.ShapeDtypeStruct((n_replicas, rows, 128), jnp.int32),
-            jax.ShapeDtypeStruct((1, 1, l2), jnp.int32),
-            jax.ShapeDtypeStruct((1, 1, l3), jnp.int32),
-            jax.ShapeDtypeStruct((1, 1, l4), jnp.int32),
-            jax.ShapeDtypeStruct((1, 1, window), jnp.int32),
-        ],
-        input_output_aliases={4: 0},
-        interpret=interpret,
-    )
+
+    def build_call(sub_r: int):
+        state_spec = pl.BlockSpec((group, rows, 128),
+                                  lambda i: (i, 0, 0))
+        return pl.pallas_call(
+            kernel,
+            grid=(sub_r // group,),
+            in_specs=[smem(), smem(), smem(), smem(), state_spec,
+                      shared(l2), shared(l3), shared(l4)],
+            out_specs=[state_spec, shared(l2), shared(l3), shared(l4),
+                       shared(window)],
+            out_shape=[
+                jax.ShapeDtypeStruct((sub_r, rows, 128), jnp.int32),
+                jax.ShapeDtypeStruct((1, 1, l2), jnp.int32),
+                jax.ShapeDtypeStruct((1, 1, l3), jnp.int32),
+                jax.ShapeDtypeStruct((1, 1, l4), jnp.int32),
+                jax.ShapeDtypeStruct((1, 1, window), jnp.int32),
+            ],
+            # NO aliasing: un-aliased in/out (pipeline race)
+            interpret=interpret,
+        )
+
+    calls = build_calls(n_replicas, chunk_r, build_call)
 
     def replay(opc, args, pt, pd, pdpt, pml4):
         with jax.enable_x64(False):
-            pt, pd, pdpt, pml4, resps = call(
-                opc, args[:, 0], args[:, 1], args[:, 2], pt,
-                pd.reshape(1, 1, l2), pdpt.reshape(1, 1, l3),
-                pml4.reshape(1, 1, l4),
+            a0, a1, a2 = args[:, 0], args[:, 1], args[:, 2]
+            pd3 = pd.reshape(1, 1, l2)
+            pdpt3 = pdpt.reshape(1, 1, l3)
+            pml43 = pml4.reshape(1, 1, l4)
+            # the level tables are canonical: each chunk recomputes the
+            # identical trajectory, so the LAST chunk's outputs speak
+            # for the fleet (run_chunks' `rest` contract)
+            (pt,), (pd_o, pdpt_o, pml4_o, resps) = run_chunks(
+                n_replicas, chunk_r, calls,
+                lambda call, r0, sub: call(
+                    opc, a0, a1, a2, pt[r0:r0 + sub], pd3, pdpt3, pml43
+                ),
+                n_plane_outs=1,
             )
-        return (pt, pd.reshape(l2), pdpt.reshape(l3), pml4.reshape(l4),
-                resps.reshape(window))
+        return (pt, pd_o.reshape(l2), pdpt_o.reshape(l3),
+                pml4_o.reshape(l4), resps.reshape(window))
 
     return replay
 
@@ -515,7 +561,7 @@ def make_vspace_plan_replay(
         raise ValueError("max_span > 512 breaks the 2-entry/level "
                          "invariant of the radix walk kernel")
     what = "radix vspace plan" if radix else "flat vspace plan"
-    rows, _ = _grid_layout(n_pages, 1, interpret, what)
+    rows, _ = _grid_layout(n_pages, 1, interpret, what, aliased=True)
     span_rows = min(-(-max_span // 128) + 1, rows)
     if not radix and n_pages < span_rows * 128 + max_span:
         raise ValueError(
@@ -641,7 +687,7 @@ def make_pallas_vspace_plan_step(
         n_pages, chunk, max_span, radix, interpret=interpret
     )
     rows, _ = _grid_layout(n_pages, 1, interpret,
-                           "vspace plan (layout)")
+                           "vspace plan (layout)", aliased=True)
     P = n_pages
 
     def to_plane(flat, dtype=jnp.int32):

@@ -140,8 +140,10 @@ class TestLockstepGuard:
         # entry mean silently skipped entries — the guard catches it
         from jax.experimental import checkify
 
+        from node_replication_tpu.models import make_sortedset
+
         R, Bw, K = 2, 2, 16
-        d = make_hashmap(K)
+        d = make_sortedset(K)
         assert d.window_plan is None and d.window_apply is not None
         spec = LogSpec(capacity=1024, n_replicas=R, arg_width=3,
                        gc_slack=16)

@@ -533,29 +533,57 @@ class TestCombinedCatchup:
             log = log_init(spec)
             log = log_append(spec, log, opcodes, args, N)
             states = replicate_state(d.init_state(), R)
-            rounds = []
+            lim_rounds = []
             # limited rounds diverge the fleet (replica 2 fully dormant),
-            # then unlimited rounds converge it — GC stalls in between
+            # then unlimited rounds converge it — GC stalls in between.
+            # Both engines follow the same lattice on the LIMITED rounds
+            # (per-replica truncation admits no shared plan); on the
+            # unlimited rounds the union-plan engine may advance lagging
+            # replicas further per round, so there we compare the
+            # position->response mapping and the converged state instead
+            # of per-round cursors.
             limit_rounds = [jnp.asarray([10, 35, 0, N], jnp.int64),
                             jnp.asarray([60, 35, 0, N], jnp.int64)]
             for lim in limit_rounds:
                 log, states, resps = eng(spec, d, log, states, W, lim)
-                rounds.append((np.asarray(resps),
-                               np.asarray(log.ltails),
-                               int(log.head), int(log.ctail)))
+                lim_rounds.append((np.asarray(resps),
+                                   np.asarray(log.ltails),
+                                   int(log.head), int(log.ctail)))
+            # consumed-response map: replica r's answer for position p
+            pos_resps = {r: {} for r in range(R)}
+            rounds = 0
             while int(np.min(np.asarray(log.ltails))) < N:
+                before = np.asarray(log.ltails).copy()
                 log, states, resps = eng(spec, d, log, states, W)
-                rounds.append((np.asarray(resps),
-                               np.asarray(log.ltails),
-                               int(log.head), int(log.ctail)))
-            outs[eng.__name__] = (jax.tree.map(np.asarray, states), rounds)
-        st_scan, r_scan = outs["log_exec_all"]
-        st_comb, r_comb = outs["log_catchup_all"]
-        assert len(r_scan) == len(r_comb)
-        for (ra, la, ha, ca), (rb, lb, hb, cb) in zip(r_scan, r_comb):
+                after = np.asarray(log.ltails)
+                resps = np.asarray(resps)
+                for r in range(R):
+                    for i in range(int(after[r] - before[r])):
+                        pos_resps[r][int(before[r]) + i] = int(
+                            resps[r, i]
+                        )
+                rounds += 1
+                assert rounds < 64, f"{eng.__name__} failed to converge"
+            outs[eng.__name__] = (
+                jax.tree.map(np.asarray, states),
+                lim_rounds,
+                pos_resps,
+                np.asarray(log.ltails),
+                int(log.head),
+            )
+        st_scan, lim_scan, pr_scan, lt_scan, h_scan = outs["log_exec_all"]
+        st_comb, lim_comb, pr_comb, lt_comb, h_comb = outs[
+            "log_catchup_all"
+        ]
+        for (ra, la, ha, ca), (rb, lb, hb, cb) in zip(lim_scan, lim_comb):
             np.testing.assert_array_equal(ra, rb)
             np.testing.assert_array_equal(la, lb)
             assert ha == hb and ca == cb
+        # each replica must answer the SAME positions with the SAME
+        # responses, regardless of how rounds chunked the catch-up
+        assert pr_scan == pr_comb
+        np.testing.assert_array_equal(lt_scan, lt_comb)
+        assert h_scan == h_comb
         for a, b in zip(jax.tree.leaves(st_scan), jax.tree.leaves(st_comb)):
             np.testing.assert_array_equal(a, b)
 
@@ -576,6 +604,54 @@ class TestCombinedCatchup:
         from node_replication_tpu.models import make_queue
 
         self._drive(make_queue(9), None, seed, 50)
+
+    @pytest.mark.parametrize("mk,nargs", [
+        ("stack", 50), ("queue", 50), ("vspace", 40), ("vspace_radix", 40),
+        ("hashmap", 30),
+    ])
+    def test_plan_is_prefix_absorbing(self, mk, nargs):
+        # the union-window catch-up contract: merging plan(state(m),
+        # [m, end)) into a replica ALREADY at p in [m, end] must land
+        # exactly on state(end) — cursors in the plan must be absolute,
+        # not deltas (the r5 queue bug: head/tail double-counted)
+        from node_replication_tpu import models as M
+
+        d = {
+            "stack": lambda: M.make_stack(9),
+            "queue": lambda: M.make_queue(9),
+            "vspace": lambda: M.make_vspace(600, max_span=8),
+            "vspace_radix": lambda: M.make_vspace_radix(1100, max_span=8),
+            "hashmap": lambda: M.make_hashmap(30),
+        }[mk]()
+        N = 64
+        rng = np.random.default_rng(1)
+        n_ops = {"stack": 2, "queue": 2, "vspace": 2, "vspace_radix": 4,
+                 "hashmap": 2}[mk]
+        opcodes = jnp.asarray(
+            rng.integers(0, n_ops + 1, N), jnp.int32
+        )
+        args = jnp.asarray(
+            np.stack([rng.integers(0, nargs, N),
+                      rng.integers(1, 60, N),
+                      rng.integers(0, 9, N)], axis=1),
+            jnp.int32,
+        )
+        snap = {}
+        st = d.init_state()
+        for i in range(N):
+            if i in (16, 25, 48):
+                snap[i] = st
+            st, _ = apply_write(d, st, opcodes[i], args[i])
+        snap[N] = st
+        plan = d.window_plan(snap[16], opcodes[16:48], args[16:48])
+        for p in (16, 25, 48):  # window start, mid-window, window end
+            merged, _ = d.window_merge(snap[p], plan)
+            for a, b in zip(jax.tree.leaves(merged),
+                            jax.tree.leaves(snap[48])):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    f"{mk}: merge from p={p} not canonical",
+                )
 
     def test_node_replicated_engines_agree(self):
         # whole-wrapper drive: per-op API with interleaved sync on both
