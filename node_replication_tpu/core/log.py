@@ -270,8 +270,16 @@ def log_catchup_all(
     window: int,
     limits: jax.Array | None = None,
     need_resps: bool = True,
+    on_trajectory: bool = True,
 ):
     """Combined catch-up: `log_exec_all` semantics at combined speed.
+
+    `on_trajectory=False` opts OUT of the union-plan tier for hand-built
+    fleets whose states are NOT folds of the shared log (tier 1's
+    soundness argument needs the trajectory property); such fleets take
+    the per-replica `window_apply` tier, which is correct for arbitrary
+    state. Every log-driven fleet (NodeReplicated, the runners, recovery,
+    grow_fleet) is on-trajectory by construction.
 
     `need_resps=False` (pure recovery: checkpoint replay, crash
     rebuild, the catch-up bench) skips the per-replica response
@@ -318,7 +326,7 @@ def log_catchup_all(
     """
     if d.window_apply is None and d.window_plan is None:
         return log_exec_all(spec, d, log, states, window, limits)
-    if d.window_plan is not None and limits is None:
+    if d.window_plan is not None and limits is None and on_trajectory:
         return _catchup_union_plan(spec, d, log, states, window,
                                    need_resps)
     if d.window_apply is None:

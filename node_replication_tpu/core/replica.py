@@ -161,11 +161,15 @@ class NodeReplicated:
 
         # Replay engine for every cursor catch-up loop (sync, read-sync,
         # combine-replay, recovery): 'combined' routes through
-        # `log_catchup_all` — per-replica `window_apply` on arbitrary
-        # divergent state, the reference's catch-up-at-hot-loop-speed
-        # contract (`nr/src/log.rs:473-524`) — 'scan' forces the generic
-        # vmapped scan, 'auto' (default) picks combined when the model
-        # provides `window_apply`.
+        # `log_catchup_all` — for plan/merge models the union-window
+        # plan, sound because this wrapper's fleet is always ON the
+        # shared replay trajectory (states are folds of the log from
+        # common init; the reference's catch-up-at-hot-loop-speed
+        # contract, `nr/src/log.rs:473-524`) — 'scan' forces the
+        # generic vmapped scan, 'auto' (default) picks combined when
+        # the model provides a combined form. Off-trajectory hand-built
+        # states must not use 'combined' (see log_catchup_all's
+        # `on_trajectory`).
         if engine not in ("auto", "combined", "scan"):
             raise ValueError(f"unknown engine {engine!r}")
         if engine == "combined" and dispatch.window_apply is None:

@@ -91,7 +91,7 @@ def make_memfs(n_files: int, n_blocks: int) -> Dispatch:
         fd = jnp.clip(args[0], 0, n_files - 1)
         return state["size"][fd]
 
-    def window_apply(state, opcodes, args):
+    def window_plan(state, opcodes, args):
         """Combined replay for the FS (see `Dispatch.window_apply`).
 
         Unlike the pure last-writer-wins models, memfs has two coupled
@@ -113,6 +113,13 @@ def make_memfs(n_files: int, n_blocks: int) -> Dispatch:
 
         Bit-identical to folding write/truncate/read_logged in order
         (tests/test_window.py::TestMemfsWindowApply).
+
+        Packaged as plan/merge (r5): the two sorts + three segmented
+        scans run once per window; the plan's final sizes are ABSOLUTE
+        (the max-affine scan folds the representative's initial sizes
+        in) and the data delta is wins/value/cleared — prefix-absorbing,
+        so the fused step shares it across the fleet and the
+        union-window catch-up engine can use it.
         """
         W = opcodes.shape[0]
         NEG = jnp.int64(-1)
@@ -288,15 +295,26 @@ def make_memfs(n_files: int, n_blocks: int) -> Dispatch:
         li = jnp.clip(last_w, 0).astype(jnp.int32)
         lv = val[li]
         ltr = last_tr_of_file[:, None]
-        data = jnp.where(
-            (last_w >= 0) & (last_w > ltr),
-            lv,
-            jnp.where(ltr >= 0, jnp.int32(0), state["data"]),
-        )
         return {
-            "data": data,
-            "size": new_size.astype(jnp.int32),
-        }, resps
+            "data_wins": (last_w >= 0) & (last_w > ltr),
+            "data_value": lv,
+            "data_cleared": ltr >= 0,
+            "size_final": new_size.astype(jnp.int32),
+            "resps": resps,
+        }
+
+    def window_merge(state, plan):
+        data = jnp.where(
+            plan["data_wins"], plan["data_value"],
+            jnp.where(plan["data_cleared"], 0, state["data"]),
+        )
+        return {"data": data, "size": plan["size_final"]}, plan["resps"]
+
+    def window_apply(state, opcodes, args):
+        # arbitrary-state form: the plan's size scan and read answers
+        # fold THIS state's sizes/blocks in, so the composition is the
+        # full per-replica sequential fold
+        return window_merge(state, window_plan(state, opcodes, args))
 
     return Dispatch(
         name=f"memfs{n_files}x{n_blocks}",
@@ -305,4 +323,6 @@ def make_memfs(n_files: int, n_blocks: int) -> Dispatch:
         read_ops=(read, size),
         arg_width=3,
         window_apply=window_apply,
+        window_plan=window_plan,
+        window_merge=window_merge,
     )

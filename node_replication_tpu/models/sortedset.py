@@ -67,14 +67,16 @@ def make_sortedset(n_keys: int) -> Dispatch:
         ks = jnp.arange(n_keys, dtype=jnp.int32)
         return jnp.sum((ks < args[0]) & state["present"]).astype(jnp.int32)
 
-    def window_apply(state, opcodes, args):
+    def window_plan(state, opcodes, args):
         """Combined replay (see `Dispatch.window_apply` and the hashmap
         twin, `models/hashmap.py`): insert/remove are last-writer-wins
         per key, and every response is presence-just-before — the
         same-key predecessor's effect, or the replica's initial presence
         on first touch. One stable sort + predecessor lookup + dense
         merge, bit-identical to the sequential fold
-        (tests/test_window.py)."""
+        (tests/test_window.py). Packaged as plan/merge (r5): the sort
+        half runs once per window; per-key finals are absolute, so the
+        plan is prefix-absorbing (union-window catch-up eligible)."""
         W = opcodes.shape[0]
         k = args[:, 0] % n_keys
         is_ins = opcodes == SS_INSERT
@@ -108,8 +110,19 @@ def make_sortedset(n_keys: int) -> Dispatch:
         )
         touched = last >= 0
         li = jnp.clip(last, 0).astype(jnp.int32)
-        present = jnp.where(touched, is_ins[li], state["present"])
-        return {"present": present}, resps
+        return {"touched": touched, "present": is_ins[li],
+                "resps": resps}
+
+    def window_merge(state, plan):
+        return {
+            "present": jnp.where(plan["touched"], plan["present"],
+                                 state["present"])
+        }, plan["resps"]
+
+    def window_apply(state, opcodes, args):
+        # arbitrary-state form: the plan's presence-before half reads
+        # THIS state, so the composition is the full per-replica fold
+        return window_merge(state, window_plan(state, opcodes, args))
 
     return Dispatch(
         name=f"sortedset{n_keys}",
@@ -118,4 +131,6 @@ def make_sortedset(n_keys: int) -> Dispatch:
         read_ops=(contains, range_count, rank),
         arg_width=3,
         window_apply=window_apply,
+        window_plan=window_plan,
+        window_merge=window_merge,
     )

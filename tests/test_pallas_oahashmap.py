@@ -114,6 +114,52 @@ class TestOaKernel:
             )
 
 
+class TestChunkedGrid:
+    def test_replica_axis_splits_into_bounded_calls(self, monkeypatch):
+        # the grid cap (ops/pallas_chunk.MAX_GRID — the hardware
+        # aliasing-race workaround) splits the replica axis into several
+        # pallas calls; force tiny chunks in interpret mode and pin that
+        # chunk concatenation and canonical responses survive the split,
+        # including a remainder chunk (R not divisible by the chunk)
+        from node_replication_tpu.ops import pallas_chunk
+        from node_replication_tpu.ops import pallas_oahashmap as poa
+
+        monkeypatch.setattr(pallas_chunk, "MAX_GRID", 2)
+        # shrink the VMEM budget so group=1 (R=7 is prime, so any budget
+        # below 7 planes-worth forces it): chunk_r = 1*2 = 2 -> chunks
+        # of 2, 2, 2 and a remainder of 1 — the split REALLY happens
+        monkeypatch.setattr(poa, "_VMEM_BUDGET", 2 * 2 * 2 * 3 * 5 * 128 * 4)
+        S_TAB, PROBE, R, W = 300, 16, 7, 24
+        rows_, _, group_ = poa._layout(S_TAB, PROBE, R, True)
+        assert group_ == 1 and pallas_chunk.chunk_size(R, group_) == 2
+        d = make_oahashmap(S_TAB, probe=PROBE)
+        rng = np.random.default_rng(4)
+        opc = jnp.asarray(rng.choice([1, 2], size=W), jnp.int32)
+        args = jnp.zeros((W, 3), jnp.int32).at[:, 0].set(
+            jnp.asarray(rng.integers(0, 64, W), jnp.int32)
+        ).at[:, 1].set(jnp.asarray(rng.integers(1, 99, W), jnp.int32))
+        ref = d.init_state()
+        rresp = []
+        for i in range(W):
+            ref, r = apply_write(d, ref, opc[i], args[i])
+            rresp.append(int(r))
+        replay = make_oahashmap_replay(S_TAB, PROBE, R, W,
+                                       interpret=True)
+        st = pallas_oahashmap_state(S_TAB, R)
+        k, v, f, resps = replay(opc, args, st["keys"], st["vals"],
+                                st["flag"])
+        assert k.shape[0] == R  # chunks concatenated back
+        assert [int(x) for x in resps] == rresp
+        view = oahashmap_model_view(
+            {"keys": k, "vals": v, "flag": f}, S_TAB
+        )
+        for key in ("keys", "vals", "flag"):
+            for r in range(R):
+                np.testing.assert_array_equal(
+                    np.asarray(view[key][r]), np.asarray(ref[key]), key
+                )
+
+
 @pytest.mark.skipif(
     not os.environ.get("NR_TPU_SMOKE"),
     reason="hardware smoke (set NR_TPU_SMOKE=1 on a real TPU)",

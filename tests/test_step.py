@@ -137,13 +137,33 @@ class TestLockstepGuard:
     def test_divergent_cursors_raise_for_window_apply_models(self):
         # window_apply-only combined steps force ltails = tail after
         # replaying just the appended span, so divergent cursors on
-        # entry mean silently skipped entries — the guard catches it
+        # entry mean silently skipped entries — the guard catches it.
+        # Inline fixture: every bundled model now carries window_plan,
+        # so build a minimal window_apply-only Dispatch (sum counter).
         from jax.experimental import checkify
 
-        from node_replication_tpu.models import make_sortedset
+        from node_replication_tpu.ops.encoding import Dispatch
 
-        R, Bw, K = 2, 2, 16
-        d = make_sortedset(K)
+        def add(state, args):
+            return {"sum": state["sum"] + args[0]}, jnp.int32(0)
+
+        def total(state, args):
+            return state["sum"]
+
+        d = Dispatch(
+            name="sumcounter",
+            make_state=lambda: {"sum": jnp.zeros((), jnp.int32)},
+            write_ops=(add,),
+            read_ops=(total,),
+            arg_width=3,
+            window_apply=lambda s, opc, a: (
+                {"sum": s["sum"] + jnp.sum(
+                    jnp.where(opc == 1, a[:, 0], 0)
+                ).astype(jnp.int32)},
+                jnp.zeros_like(opc),
+            ),
+        )
+        R, Bw = 2, 2
         assert d.window_plan is None and d.window_apply is not None
         spec = LogSpec(capacity=1024, n_replicas=R, arg_width=3,
                        gc_slack=16)

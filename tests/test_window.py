@@ -607,7 +607,7 @@ class TestCombinedCatchup:
 
     @pytest.mark.parametrize("mk,nargs", [
         ("stack", 50), ("queue", 50), ("vspace", 40), ("vspace_radix", 40),
-        ("hashmap", 30),
+        ("hashmap", 30), ("sortedset", 30), ("memfs", 5),
     ])
     def test_plan_is_prefix_absorbing(self, mk, nargs):
         # the union-window catch-up contract: merging plan(state(m),
@@ -622,11 +622,13 @@ class TestCombinedCatchup:
             "vspace": lambda: M.make_vspace(600, max_span=8),
             "vspace_radix": lambda: M.make_vspace_radix(1100, max_span=8),
             "hashmap": lambda: M.make_hashmap(30),
+            "sortedset": lambda: M.make_sortedset(30),
+            "memfs": lambda: M.make_memfs(5, 64),
         }[mk]()
         N = 64
         rng = np.random.default_rng(1)
         n_ops = {"stack": 2, "queue": 2, "vspace": 2, "vspace_radix": 4,
-                 "hashmap": 2}[mk]
+                 "hashmap": 2, "sortedset": 2, "memfs": 3}[mk]
         opcodes = jnp.asarray(
             rng.integers(0, n_ops + 1, N), jnp.int32
         )
@@ -652,6 +654,43 @@ class TestCombinedCatchup:
                     np.asarray(a), np.asarray(b),
                     f"{mk}: merge from p={p} not canonical",
                 )
+
+    def test_off_trajectory_flag_uses_window_apply(self):
+        # hand-built fleets whose states are NOT folds of the shared log
+        # must opt out of the union-plan tier; on_trajectory=False takes
+        # the per-replica window_apply tier, correct for arbitrary state
+        from node_replication_tpu.core.log import (
+            log_append,
+            log_catchup_all,
+        )
+
+        K, R, N, W = 16, 2, 8, 8
+        d = make_hashmap(K)
+        spec = LogSpec(capacity=64, n_replicas=R, arg_width=3,
+                       gc_slack=8)
+        log = log_init(spec)
+        opc = jnp.full((N,), HM_PUT, jnp.int32)
+        ag = jnp.zeros((N, 3), jnp.int32).at[:, 0].set(
+            jnp.arange(N, dtype=jnp.int32)
+        ).at[:, 1].set(100)
+        log = log_append(spec, log, opc, ag, N)
+        # off-trajectory: replica 1 starts with a key the log never wrote
+        states = replicate_state(d.init_state(), R)
+        states = dict(states)
+        states["values"] = states["values"].at[1, 15].set(999)
+        states["present"] = states["present"].at[1, 15].set(True)
+        log2, st2, _ = log_catchup_all(
+            spec, d, log, states, W, on_trajectory=False
+        )
+        # replica 1 keeps its private key (untouched by the window) and
+        # still applies the log's writes — the per-replica fold semantics
+        assert int(st2["values"][1, 15]) == 999
+        assert bool(st2["present"][1, 15])
+        assert int(st2["values"][0, 15]) == 0
+        for r in range(R):
+            for k in range(N):
+                assert int(st2["values"][r, k]) == 100
+        assert (np.asarray(log2.ltails) == N).all()
 
     def test_node_replicated_engines_agree(self):
         # whole-wrapper drive: per-op API with interleaved sync on both
