@@ -3,7 +3,10 @@
 # short durations, CSVs into benches/out/). Pass --full via FULL=1.
 set -euo pipefail
 cd "$(dirname "$0")"
-OUT=out
+# OUT is overridable (OUT=/tmp/smoke ./run_all.sh): the default wipes
+# benches/out — point elsewhere to smoke-test without clobbering the
+# committed measurement CSVs
+OUT=${OUT:-out}
 mkdir -p "$OUT"
 rm -f "$OUT"/*.csv  # fresh run: the CSV writers append
 EXTRA=${FULL:+--full}

@@ -172,14 +172,17 @@ class NodeReplicated:
         # `on_trajectory`).
         if engine not in ("auto", "combined", "scan"):
             raise ValueError(f"unknown engine {engine!r}")
-        if engine == "combined" and dispatch.window_apply is None:
+        has_combined = (
+            dispatch.window_apply is not None
+            or dispatch.window_plan is not None
+        )
+        if engine == "combined" and not has_combined:
             raise ValueError(
-                f"engine='combined' but {dispatch.name} has no window_apply"
+                f"engine='combined' but {dispatch.name} has no "
+                f"window_apply or window_plan"
             )
         use_combined = (
-            dispatch.window_apply is not None
-            if engine == "auto"
-            else engine == "combined"
+            has_combined if engine == "auto" else engine == "combined"
         )
         self.engine = "combined" if use_combined else "scan"
         self._build_jits()
