@@ -36,7 +36,7 @@ python hashbench.py -r 2 -w 1 --replicas 2 --duration "$DUR" \
   --ffi-smoke $EXTRA
 python chashbench.py -r 2 -w 2 --replicas 2 --duration "$DUR" $EXTRA
 python hashmap.py --sparse --keys 4096 --replicas 8 --duration "$DUR" \
-  $EXTRA
+  --out-dir "$OUT" $EXTRA
 python rwlockbench.py -r 1 4 -w 0 1 --duration "$DUR" $EXTRA
 XLA_FLAGS=--xla_force_host_platform_device_count=8 python ringreplay.py \
   --cpu --devices 8 --window 512 --replicas 8 --duration "$DUR"

@@ -172,6 +172,13 @@ class NodeReplicated:
         # `on_trajectory`).
         if engine not in ("auto", "combined", "scan"):
             raise ValueError(f"unknown engine {engine!r}")
+        if (dispatch.window_plan is None) != (
+            dispatch.window_merge is None
+        ):
+            raise ValueError(
+                f"{dispatch.name}: window_plan and window_merge come "
+                f"as a pair (got only one)"
+            )
         has_combined = (
             dispatch.window_apply is not None
             or dispatch.window_plan is not None

@@ -114,6 +114,18 @@ def main():
                       log_capacity=1 << 14, nlogs=4) as e:
         drive(e, n_threads=6, mixed_logs=True, keyspace=512)
 
+    # r5: the comparison maps have their own concurrency protocols —
+    # the lockfree map's packed-slot CAS probes and the evmap left-right
+    # pin/flip/drain/replay cycle (reads race plain table stores unless
+    # the drain is airtight; the r5 review found a re-pin hole here)
+    print(f"phase 4: comparison maps (lockfree, evmap) ({DUR}s)",
+          flush=True)
+    from node_replication_tpu.native import bench_cmp
+
+    for system in ("lockfree", "evmap"):
+        total, _ = bench_cmp(system, 8, 30, 4096, 32, int(DUR * 1000), 3)
+        assert total > 0, system
+
     print("tsan stress OK (see stderr for sanitizer reports)", flush=True)
 
 
