@@ -18,8 +18,9 @@ python bench.py --replicas 256 --keys 1024 --steps 8 --repeats 2 \
   --min-time 0.3 | tee "$OUT/bench.json"
 
 echo "== bench suite =="
-DUR=${DUR:-1.0} FULL=${FULL:-} bash benches/run_all.sh
-cp -f benches/out/*.csv "$OUT/" 2>/dev/null || true
+# rows land straight in $OUT: the default would wipe the committed
+# measurement CSVs in benches/out (run_all.sh's OUT override, r5)
+OUT="$OUT" DUR=${DUR:-1.0} FULL=${FULL:-} bash benches/run_all.sh
 
 echo "== plots =="
 python benches/plot.py --csv "$OUT/scaleout_benchmarks.csv" \
