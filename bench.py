@@ -101,6 +101,7 @@ def main():
     d = make_hashmap(args.keys)
     log = log_init(spec)
     if args.path == "pallas":
+        from node_replication_tpu.obs.metrics import get_registry
         from node_replication_tpu.ops.pallas_replay import (
             make_pallas_step,
             pallas_hashmap_state,
@@ -110,6 +111,9 @@ def main():
             step = make_pallas_step(args.keys, spec, Bw, Br)
         except ValueError as e:
             sys.exit(f"--pallas config rejected: {e}")
+        # third engine tier of the log.engine.* selection counters
+        # (scan / window_apply / union_plan live in core/log.py)
+        get_registry().counter("log.engine.pallas").inc()
         states = pallas_hashmap_state(args.keys, R)
     else:
         combined = None if args.path == "auto" else (args.path == "combined")
@@ -180,6 +184,8 @@ def main():
     # most reproducible number the run could obtain, plus every
     # attempt's median for the audit trail.
     attempts = []
+    tracer = get_tracer()
+    measure_t0 = time.perf_counter()
     with trace_span("bench-measure", steps=n_steps * args.repeats):
         for attempt in range(args.max_attempts):
             values = []
@@ -188,6 +194,30 @@ def main():
                 log, states = run(n_steps, log, states)
                 elapsed = time.perf_counter() - start
                 values.append(per_step * n_steps / elapsed)
+                if tracer.enabled:
+                    # per-second throughput samples for the report CLI's
+                    # timeline; `run` ends on a real fence, so the ops
+                    # count covers executed device work, not dispatch.
+                    # A repeat can span several seconds — spread its ops
+                    # over the seconds it covered (proportional to
+                    # overlap) so the timeline's per-second rate is
+                    # honest instead of bulk-dumping a multi-second
+                    # repeat into one inflated bucket.
+                    rel0 = start - measure_t0
+                    rel1 = time.perf_counter() - measure_t0
+                    total_ops = per_step * n_steps
+                    dur = max(rel1 - rel0, 1e-9)
+                    for sec in range(int(rel0), int(rel1) + 1):
+                        overlap = min(rel1, sec + 1) - max(rel0, sec)
+                        if overlap <= 0:
+                            continue
+                        tracer.emit(
+                            "throughput",
+                            second=sec,
+                            ops=int(round(total_ops * overlap / dur)),
+                            ops_per_sec=values[-1],
+                            attempt=attempt,
+                        )
             med = statistics.median(values)
             spread = 100.0 * (max(values) - min(values)) / med
             attempts.append((spread, med, values))

@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import io
 import os
+import time
 from typing import Any
 
 import jax
@@ -36,7 +37,9 @@ from node_replication_tpu.core.log import (
     log_catchup_all,
 )
 from node_replication_tpu.core.replica import replicate_state
+from node_replication_tpu.obs.metrics import get_registry
 from node_replication_tpu.ops.encoding import Dispatch
+from node_replication_tpu.utils.trace import span
 
 PyTree = Any
 
@@ -51,24 +54,32 @@ def save_snapshot(path: str, spec: LogSpec, log: LogState,
     load from the flattened leaf order plus the treedef of the caller's
     template, so save/load pairs must use the same Dispatch.
     """
-    leaves, _ = jax.tree.flatten(states)
-    payload = {
-        "spec": np.asarray([getattr(spec, f) for f in _SPEC_FIELDS],
-                           np.int64),
-        "log_opcodes": np.asarray(log.opcodes),
-        "log_args": np.asarray(log.args),
-        "log_head": np.asarray(log.head),
-        "log_tail": np.asarray(log.tail),
-        "log_ctail": np.asarray(log.ctail),
-        "log_ltails": np.asarray(log.ltails),
-        "n_state_leaves": np.int64(len(leaves)),
-    }
-    for i, leaf in enumerate(leaves):
-        payload[f"state_{i}"] = np.asarray(leaf)
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **payload)
-    os.replace(tmp, path)
+    t0 = time.perf_counter()
+    # np.asarray on device outputs is a data-dependent readback, so the
+    # span below covers real device drain + serialization, not dispatch
+    with span("checkpoint-save", path=path,
+              tail=int(np.asarray(log.tail))):
+        leaves, _ = jax.tree.flatten(states)
+        payload = {
+            "spec": np.asarray([getattr(spec, f) for f in _SPEC_FIELDS],
+                               np.int64),
+            "log_opcodes": np.asarray(log.opcodes),
+            "log_args": np.asarray(log.args),
+            "log_head": np.asarray(log.head),
+            "log_tail": np.asarray(log.tail),
+            "log_ctail": np.asarray(log.ctail),
+            "log_ltails": np.asarray(log.ltails),
+            "n_state_leaves": np.int64(len(leaves)),
+        }
+        for i, leaf in enumerate(leaves):
+            payload[f"state_{i}"] = np.asarray(leaf)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    get_registry().histogram("checkpoint.save_s").observe(
+        time.perf_counter() - t0
+    )
 
 
 def peek_spec(path: str) -> LogSpec:
@@ -84,7 +95,8 @@ def load_snapshot(path: str, states_template: PyTree
                   ) -> tuple[LogSpec, LogState, PyTree]:
     """Load a snapshot; `states_template` supplies the pytree structure
     (e.g. `replicate_state(d.init_state(), R)`)."""
-    with np.load(path) as z:
+    t0 = time.perf_counter()
+    with span("checkpoint-load", path=path), np.load(path) as z:
         spec = LogSpec(**dict(zip(_SPEC_FIELDS,
                                   (int(v) for v in z["spec"]))))
         log = LogState(
@@ -97,6 +109,9 @@ def load_snapshot(path: str, states_template: PyTree
         )
         n = int(z["n_state_leaves"])
         leaves = [jnp.asarray(z[f"state_{i}"]) for i in range(n)]
+    get_registry().histogram("checkpoint.load_s").observe(
+        time.perf_counter() - t0
+    )
     treedef = jax.tree.structure(states_template)
     return spec, log, jax.tree.unflatten(treedef, leaves)
 
@@ -142,6 +157,18 @@ def recover_states(
                                        need_resps=False)
     )
     states = base_states
-    while int(jnp.min(log.ltails)) < int(log.tail):
-        log, states, _ = exec_jit(log, states)
+    t0 = time.perf_counter()
+    rounds = 0
+    with span("recover", start=start, tail=int(log.tail),
+              window=window) as sp:
+        while int(jnp.min(log.ltails)) < int(log.tail):
+            log, states, _ = exec_jit(log, states)
+            rounds += 1
+        sp.add(rounds=rounds)
+        sp.fence(log, states)
+    reg = get_registry()
+    reg.histogram("checkpoint.recover_s").observe(
+        time.perf_counter() - t0
+    )
+    reg.counter("checkpoint.recover_rounds").inc(rounds)
     return log, states

@@ -38,8 +38,9 @@ from node_replication_tpu.core.replica import (
     replicate_state,
     states_equal,
 )
+from node_replication_tpu.obs.metrics import COUNT_BUCKETS, get_registry
 from node_replication_tpu.ops.encoding import Dispatch, apply_read, encode_ops
-from node_replication_tpu.utils.trace import get_tracer
+from node_replication_tpu.utils.trace import get_tracer, span
 
 logger = logging.getLogger("node_replication_tpu")
 
@@ -80,6 +81,19 @@ class MultiLogReplicated:
         self._inflight: dict[tuple[int, int], deque] = {}
         # delivered responses per thread, in enqueue order per log
         self._resps: dict[tuple[int, int], deque] = {}
+        # per-log observability: LogMapper routing counts, combiner
+        # passes, replay rounds (+ idle skips) per log
+        self._log_selected = [0] * nlogs
+        self._combine_rounds = [0] * nlogs
+        self._exec_rounds = 0
+        self._idle_rounds = 0
+        reg = get_registry()
+        self._m_rounds = reg.counter("cnr.exec.rounds")
+        self._m_idle = reg.counter("cnr.exec.idle_rounds")
+        self._m_combine = reg.counter("cnr.combine.rounds")
+        self._m_batch = reg.histogram("cnr.combine.batch_size",
+                                      buckets=COUNT_BUCKETS)
+        self._m_stalls = reg.counter("cnr.watchdog.stalls")
 
         spec, d = self.spec, dispatch
 
@@ -154,7 +168,9 @@ class MultiLogReplicated:
         return ReplicaToken(rid, tid)
 
     def _map(self, op: tuple) -> int:
-        return self.log_mapper(op[0], tuple(op[1:])) % self.nlogs
+        h = self.log_mapper(op[0], tuple(op[1:])) % self.nlogs
+        self._log_selected[h] += 1
+        return h
 
     def execute_mut(self, op: tuple, token: ReplicaToken):
         """Route the write to its log, combine that log, return its
@@ -235,6 +251,9 @@ class MultiLogReplicated:
         if n == 0:
             self._exec_round(log_idx)
             return
+        self._combine_rounds[log_idx] += 1
+        self._m_combine.inc()
+        self._m_batch.observe(n)
         rounds = 0
         while (
             self.spec.capacity - self.spec.gc_slack
@@ -247,17 +266,22 @@ class MultiLogReplicated:
         opcodes, args, _ = encode_ops(
             [(o, *a) for _, o, a in ops], self.spec.arg_width, pad_to=pad
         )
-        self.ml = self._append_jit(
-            self.ml, log_idx, opcodes, args, jnp.int64(n)
-        )
+        with span("append", log=log_idx, rid=rid, n=n, pos0=pos0) as sp:
+            self.ml = self._append_jit(
+                self.ml, log_idx, opcodes, args, jnp.int64(n)
+            )
+            sp.fence(self.ml)
         infl = self._inflight.setdefault((rid, log_idx), deque())
         for j, (tid, _, _) in enumerate(ops):
             infl.append((pos0 + j, tid))
         target = pos0 + n
         rounds = 0
-        while int(np.asarray(self.ml.ltails)[log_idx, rid]) < target:
-            self._exec_round(log_idx)
-            rounds = self._watchdog(rounds, log_idx, "combine-replay")
+        with span("combine-replay", log=log_idx, rid=rid,
+                  target=target) as sp:
+            while int(np.asarray(self.ml.ltails)[log_idx, rid]) < target:
+                self._exec_round(log_idx)
+                rounds = self._watchdog(rounds, log_idx, "combine-replay")
+            sp.fence(self.ml, self.states)
 
     def sync(self, rid: int | None = None) -> None:
         """Catch up on every log (`cnr/src/replica.rs:579-597`)."""
@@ -292,16 +316,85 @@ class MultiLogReplicated:
         return states_equal(self.states)
 
     def stats(self) -> dict:
+        """Flat per-log counters (original three keys stable);
+        `snapshot()` is the structured superset."""
         return {
             "tails": [int(t) for t in np.asarray(self.ml.tail)],
             "ctails": [int(t) for t in np.asarray(self.ml.ctail)],
             "heads": [int(t) for t in np.asarray(self.ml.head)],
+            "log_selected": list(self._log_selected),
+            "combine_rounds": list(self._combine_rounds),
+            "exec_rounds": self._exec_rounds,
+            "idle_rounds": self._idle_rounds,
+        }
+
+    def snapshot(self) -> dict:
+        """Structured observability snapshot (JSON-safe), the CNR twin of
+        `NodeReplicated.snapshot()`: per-log cursors and per-(log,
+        replica) lag, LogMapper routing counts (skew at a glance),
+        combiner passes and replay rounds per log, plus the process-wide
+        metrics view when enabled."""
+        tails = np.asarray(self.ml.tail)
+        heads = np.asarray(self.ml.head)
+        ctails = np.asarray(self.ml.ctail)
+        ltails = np.asarray(self.ml.ltails)
+        logs = []
+        for l in range(self.nlogs):
+            lag = [int(tails[l] - lt) for lt in ltails[l]]
+            logs.append({
+                "tail": int(tails[l]),
+                "head": int(heads[l]),
+                "ctail": int(ctails[l]),
+                "lag": lag,
+                "max_lag": max(lag) if lag else 0,
+                "selected": self._log_selected[l],
+                "combine_rounds": self._combine_rounds[l],
+                "occupancy": (int(tails[l]) - int(heads[l]))
+                / self.spec.capacity,
+            })
+        total_sel = sum(self._log_selected)
+        return {
+            "nlogs": self.nlogs,
+            "capacity": self.spec.capacity,
+            "logs": logs,
+            # routing imbalance: max over mean selections (1.0 = even)
+            "selection_imbalance": (
+                max(self._log_selected) * self.nlogs / total_sel
+                if total_sel else 0.0
+            ),
+            "replicas": {
+                "n": self.n_replicas,
+                "threads": list(self._threads_per_replica),
+            },
+            "exec": {
+                "window": self.exec_window,
+                "rounds": self._exec_rounds,
+                "idle_rounds": self._idle_rounds,
+            },
+            "metrics": get_registry().snapshot(),
         }
 
     # ------------------------------------------------------------ internals
 
     def _exec_round(self, log_idx: int) -> None:
-        lt_before = np.asarray(self.ml.ltails)[log_idx].copy()
+        # one fused cursor readback per round (see the
+        # NodeReplicated._exec_round note on tunnel D2H RTTs)
+        cur = np.asarray(
+            jnp.concatenate(
+                [self.ml.ltails[log_idx], self.ml.tail[log_idx][None]]
+            )
+        ).copy()
+        lt_before, tail = cur[:-1], int(cur[-1])
+        # idle short-circuit (the NodeReplicated._exec_round twin): all
+        # replicas at this log's tail → nothing to replay, skip the
+        # device round; every caller loops on a cursor condition already
+        # satisfied, so skipping cannot livelock
+        if int(lt_before.min()) >= tail and int(lt_before.max()) <= tail:
+            self._idle_rounds += 1
+            self._m_idle.inc()
+            return
+        self._exec_rounds += 1
+        self._m_rounds.inc()
         self.ml, self.states, resps = self._exec_jit(
             self.ml, self.states, log_idx=log_idx, window=self.exec_window
         )
@@ -322,6 +415,7 @@ class MultiLogReplicated:
         # Re-warn every WARN_ROUNDS forever, like the reference's per-log
         # GC starvation callback (`cnr/src/log.rs:505-515`).
         if rounds % WARN_ROUNDS == 0:
+            self._m_stalls.inc()
             lt = np.asarray(self.ml.ltails)[log_idx]
             dormant = int(np.argmin(lt))
             tail = int(np.asarray(self.ml.tail)[log_idx])

@@ -38,12 +38,27 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
+from node_replication_tpu.obs.metrics import get_registry
 from node_replication_tpu.ops.encoding import Dispatch, NOOP, apply_write
 from node_replication_tpu.utils.checks import check
 
 PyTree = Any
+
+# Engine-dispatch counters: which replay tier `log_catchup_all` routed a
+# call to (scan / per-replica window_apply / union-window plan; the
+# pallas tier is counted at its construction site, ops/pallas_replay via
+# bench.py). These increment on the HOST side of the tier decision, so
+# under jit they count per trace/compile; eager callers (and the
+# recovery loop's first call, re-trace after fleet growth, …) count per
+# invocation. Per-round engine usage of the stateful wrappers is the
+# separate `nr.exec.engine.*` / `cnr.exec.rounds` family.
+_m_engine_scan = get_registry().counter("log.engine.scan")
+_m_engine_window = get_registry().counter("log.engine.window_apply")
+_m_engine_union = get_registry().counter("log.engine.union_plan")
+_m_idle_skips = get_registry().counter("log.engine.idle_skip")
 
 # Default number of log entries. The reference defaults to 32 MiB of 64-byte
 # entries = 2^19 slots "based on the ASPLOS 2017 paper" (`nr/src/log.rs:19-22`);
@@ -325,12 +340,15 @@ def log_catchup_all(
     `tests/test_window.py::TestCombinedCatchup`.
     """
     if d.window_apply is None and d.window_plan is None:
+        _m_engine_scan.inc()
         return log_exec_all(spec, d, log, states, window, limits)
     if d.window_plan is not None and limits is None and on_trajectory:
         return _catchup_union_plan(spec, d, log, states, window,
                                    need_resps)
     if d.window_apply is None:
+        _m_engine_scan.inc()
         return log_exec_all(spec, d, log, states, window, limits)
+    _m_engine_window.inc()
 
     def one(state, ltail, limit=None):
         eff_tail = (
@@ -389,6 +407,24 @@ def _catchup_union_plan(
     window end must not merge (the plan's final values could rewind
     them); they are masked out and keep their state and cursor.
     """
+    # Idle short-circuit (ADVICE r5): when even the most-lagging replica
+    # is at the tail there is nothing to replay, and the full
+    # plan-sort + vmapped merge below would run for nothing. Host-side
+    # check, so it only triggers for EAGER callers whose cursors are
+    # concrete; under jit the cursors are tracers and the caller is
+    # responsible for the skip (NodeReplicated._exec_round holds the
+    # jit-hot equivalent).
+    if not isinstance(log.tail, jax.core.Tracer) and not isinstance(
+        log.ltails, jax.core.Tracer
+    ):
+        lt = np.asarray(log.ltails)
+        # every cursor exactly at tail (the max bound lets corrupted
+        # ltails > tail fall through to the debug-mode checks below)
+        if int(lt.min()) >= int(log.tail) >= int(lt.max()):
+            _m_idle_skips.inc()
+            R = log.ltails.shape[0]
+            return log, states, jnp.zeros((R, window), jnp.int32)
+    _m_engine_union.inc()
     m = jnp.min(log.ltails)
     end = jnp.minimum(m + window, log.tail)
     check(m >= log.head,

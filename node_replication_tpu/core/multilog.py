@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from node_replication_tpu.core.log import LogSpec, gather_window
+from node_replication_tpu.obs.metrics import get_registry
 from node_replication_tpu.ops.encoding import (
     Dispatch,
     NOOP,
@@ -51,6 +52,14 @@ from node_replication_tpu.ops.encoding import (
 from node_replication_tpu.utils.checks import check
 
 PyTree = Any
+
+# Multi-log replay-engine selection counters (host-side of the tier
+# decision in `multilog_exec_all`; under jit they count per trace —
+# see the `log.engine.*` note in core/log.py).
+_m_ml_lockstep = get_registry().counter("multilog.engine.combined_lockstep")
+_m_ml_combined = get_registry().counter("multilog.engine.combined")
+_m_ml_part_scan = get_registry().counter("multilog.engine.partitioned_scan")
+_m_ml_seq = get_registry().counter("multilog.engine.sequential")
 
 # LogMapper: host-side commutativity hash (`cnr/src/lib.rs:123-137`).
 LogMapper = Callable[[int, tuple], int]
@@ -235,6 +244,8 @@ def multilog_exec_all(
         stacked = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), stacked)
 
         if combined and lockstep and window > 0:
+            _m_ml_lockstep.inc()
+
             # lock-step: gather each log's window once (ltails[0] speaks
             # for the fleet) so the window-wide sort inside window_apply
             # stays UNBATCHED across the replica vmap
@@ -260,6 +271,8 @@ def multilog_exec_all(
                     jnp.broadcast_to(new_lt, ltails.shape),
                 )
         else:
+            (_m_ml_combined if combined else _m_ml_part_scan).inc()
+
             def per_log(opc, arg, tail, sub_states, ltails):
                 return jax.vmap(
                     lambda s, lt: exec_one(
@@ -274,6 +287,7 @@ def multilog_exec_all(
         new_subs = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), new_subs)
         states = jax.vmap(partitioned.merge)(new_subs)
     else:
+        _m_ml_seq.inc()
         resps_list = []
         ltails_list = []
         for l in range(spec.nlogs):

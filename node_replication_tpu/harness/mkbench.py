@@ -84,20 +84,25 @@ def _append_csv(path: str, fields: list[str], rows: list[dict]) -> None:
     if parent:
         os.makedirs(parent, exist_ok=True)
     if os.path.exists(path):
-        # schema upgrade: when an existing CSV predates newly added
-        # columns (e.g. wr_eff), rewrite it once — old rows keep "" in
-        # the new columns, so historical measurements stay valid
+        # schema upgrade: whenever the existing header differs from the
+        # current schema IN ANY WAY — new columns, removed columns, or a
+        # reordered same-set header — rewrite the file once under the
+        # canonical field order. Old rows keep "" in columns they predate
+        # and drop columns the schema no longer has, so historical
+        # measurements stay valid and appended rows can never land
+        # misaligned under a stale header (ADVICE r5: the old
+        # strict-subset check let reordered/removed-column headers fall
+        # through to a misaligned append).
         with open(path, newline="") as f:
             r = csv.reader(f)
             header = next(r, None)
-            if header is not None and header != fields and set(
-                header
-            ) < set(fields):
+            if header is not None and header != fields:
                 old_rows = [dict(zip(header, row)) for row in r]
                 tmp = f"{path}.{os.getpid()}.tmp"
                 with open(tmp, "w", newline="") as g:
                     w = csv.DictWriter(g, fieldnames=fields,
-                                       restval="")
+                                       restval="",
+                                       extrasaction="ignore")
                     w.writeheader()
                     w.writerows(old_rows)
                 os.replace(tmp, path)
@@ -178,6 +183,12 @@ def measure_step_runner(
     dur = time.perf_counter() - t0
     tracer = get_tracer()
     if tracer.enabled:
+        # per-second capture into the trace (the reference's per-second
+        # counters, `benches/mkbench.rs:755-761`): one `throughput`
+        # event per wall-clock-second bucket — the report CLI's timeline
+        for sec, ops in sorted(buckets.items()):
+            tracer.emit("throughput", runner=runner.name, second=sec,
+                        ops=ops)
         tracer.emit(
             "measure", runner=runner.name, duration_s=dur,
             client_ops=total_client, dispatches=total,

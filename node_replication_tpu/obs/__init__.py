@@ -1,0 +1,35 @@
+"""Observability layer: metrics registry + flight recorder + trace report.
+
+- `obs.metrics` — process-wide counters/gauges/histograms
+  (`get_registry()`; enable with NR_TPU_METRICS=1).
+- `obs.recorder` — the `Tracer` flight recorder and `span` timing
+  context (enable with NR_TPU_TRACE=<path|mem>; fence-accurate spans
+  with NR_TPU_TRACE_FENCE=1). `utils/trace.py` re-exports these for
+  backward compatibility.
+- `obs.report` — trace-report CLI:
+  `python -m node_replication_tpu.obs.report trace.jsonl`.
+"""
+
+from node_replication_tpu.obs.metrics import (
+    COUNT_BUCKETS,
+    DURATION_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from node_replication_tpu.obs.recorder import Tracer, get_tracer, span
+
+__all__ = [
+    "COUNT_BUCKETS",
+    "DURATION_BUCKETS_S",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Tracer",
+    "get_registry",
+    "get_tracer",
+    "span",
+]

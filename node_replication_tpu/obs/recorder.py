@@ -1,0 +1,188 @@
+"""Flight recorder: structured JSONL events + fence-accurate timing spans.
+
+The trace half of the observability layer (`obs/metrics.py` is the
+metrics half). The reference's observability story is the `log` crate
+facade plus spin-loop diagnostics every WARN_THRESHOLD iterations
+(`nr/src/lib.rs:80-81`, `nr/src/log.rs:351-358`) and the harness's
+per-second throughput counters (`benches/mkbench.rs:755-761`). This module
+is the TPU build's equivalent: a process-wide `Tracer` that appends JSONL
+events (`{"ts", "mono", "event", ...fields}`) to a file, collects them in
+an unbounded buffer, or keeps the last N in a ring (flight-recorder
+mode — always-on tracing whose memory cost is bounded, dump on incident).
+
+Every event carries both a wall-clock `ts` (time.time, for correlating
+with external logs) and a monotonic `mono` (time.monotonic, immune to
+clock steps — what the report CLI uses to order and bucket events).
+
+Spans: `span("name", **fields)` times a section and emits `duration_s`
+on exit. Because `jax.block_until_ready` returns at enqueue-ack on the
+tunneled TPU platform (see `utils/fence.py` — the round-1/2 bench
+retraction), a naive span around device work measures DISPATCH rate, not
+execution. Opt into fence-accurate spans with `NR_TPU_TRACE_FENCE=1`
+(or `get_tracer().fence_spans = True`) and tell the span what to fence:
+
+    with span("exec-round") as sp:
+        log, states = run_device_work(...)
+        sp.fence(log, states)          # fenced at exit when opted in
+
+At exit the span runs `utils/fence.py:fence()` over the registered
+pytrees before taking the end timestamp, so `duration_s` covers actual
+device execution; the emitted event carries `fenced: true`. Without the
+opt-in, `sp.fence` only records that a fence target existed (zero device
+cost) and spans measure host wall time as before.
+
+Disabled by default: `emit` is one branch, and `span` yields a shared
+no-op singleton without reading the clock or allocating an event record
+(asserted by tests/test_obs.py). Enable with `NR_TPU_TRACE=<path>`
+(file), `NR_TPU_TRACE=mem` (in-memory; bound it with
+`NR_TPU_TRACE_RING=<n>`), or `get_tracer().enable(...)`.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any
+
+
+class _Span:
+    """Mutable per-span holder the `span` context manager yields: attach
+    late fields with `add(...)`, register device pytrees to fence with
+    `fence(...)`."""
+
+    __slots__ = ("fields", "fence_args")
+
+    def __init__(self):
+        self.fields: dict[str, Any] = {}
+        self.fence_args: tuple | None = None
+
+    def add(self, **fields: Any) -> None:
+        self.fields.update(fields)
+
+    def fence(self, *trees: Any) -> None:
+        self.fence_args = trees
+
+
+class _NullSpan:
+    """Shared do-nothing span for the disabled path (no allocation)."""
+
+    __slots__ = ()
+
+    def add(self, **fields: Any) -> None:
+        pass
+
+    def fence(self, *trees: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fh = None
+        self._buffer: "collections.deque[dict] | list[dict] | None" = None
+        self.enabled = False
+        # fence-accurate span mode (see module docstring); mutable at
+        # runtime so tests and notebooks can flip it per section
+        self.fence_spans = (
+            os.environ.get("NR_TPU_TRACE_FENCE", "") == "1"
+        )
+
+    def enable(self, path: str | None = None,
+               ring: int | None = None) -> None:
+        """Write events to `path`; with `path=None` buffer in memory —
+        unbounded by default, or the last `ring` events when given
+        (flight-recorder mode)."""
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+            if path:
+                self._fh = open(path, "a", buffering=1)
+                self._buffer = None
+            else:
+                self._fh = None
+                self._buffer = (
+                    collections.deque(maxlen=int(ring))
+                    if ring else []
+                )
+            self.enabled = True
+
+    def disable(self) -> None:
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+            self._fh = None
+            self._buffer = None
+            self.enabled = False
+
+    def emit(self, event: str, **fields: Any) -> None:
+        if not self.enabled:
+            return
+        rec = {
+            "ts": time.time(),
+            "mono": time.monotonic(),
+            "event": event,
+            **fields,
+        }
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(json.dumps(rec) + "\n")
+            elif self._buffer is not None:
+                self._buffer.append(rec)
+
+    def events(self) -> list[dict]:
+        """Buffered events (memory/ring mode only), oldest first."""
+        with self._lock:
+            return list(self._buffer or [])
+
+
+_tracer = Tracer()
+_env = os.environ.get("NR_TPU_TRACE")
+if _env:
+    _ring = os.environ.get("NR_TPU_TRACE_RING")
+    if _env in ("mem", ":mem:"):
+        _tracer.enable(None, ring=int(_ring) if _ring else None)
+    else:
+        _tracer.enable(_env)
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+@contextlib.contextmanager
+def span(event: str, **fields: Any):
+    """Time a section; emits `<event>` with `duration_s` on exit.
+
+    Yields a `_Span`: call `sp.add(...)` for fields only known inside the
+    section and `sp.fence(*pytrees)` to make the span fence device work
+    before the end timestamp under `NR_TPU_TRACE_FENCE=1` (see module
+    docstring). Disabled tracer: yields a shared no-op span, reads no
+    clock, allocates no record.
+    """
+    t = _tracer
+    if not t.enabled:
+        yield _NULL_SPAN
+        return
+    sp = _Span()
+    t0 = time.perf_counter()
+    try:
+        yield sp
+    finally:
+        fenced = False
+        if t.fence_spans and sp.fence_args is not None:
+            # import at call time: utils.fence pulls in jax, and the
+            # utils package __init__ imports this module back
+            from node_replication_tpu.utils.fence import fence
+
+            fence(*sp.fence_args)
+            fenced = True
+        dur = time.perf_counter() - t0
+        t.emit(event, duration_s=dur, fenced=fenced, **fields,
+               **sp.fields)

@@ -1,0 +1,250 @@
+"""Trace-report CLI: summarize a flight-recorder JSONL trace.
+
+    python -m node_replication_tpu.obs.report trace.jsonl [--json]
+
+Sections:
+
+- **events** — per-event-name counts.
+- **spans** — p50/p95/p99/max durations for every event that carries
+  `duration_s` (append, combine-replay, exec-round, checkpoint-*, …),
+  with a `fenced` marker when the spans were fence-accurate
+  (NR_TPU_TRACE_FENCE=1; an unfenced span on the tunneled TPU platform
+  measures dispatch rate, not execution — BENCH_NOTES.md).
+- **throughput** — ops/sec timeline from `throughput` events (the
+  harness's per-second capture, `benches/mkbench.rs:755-761`); when a
+  trace has none (e.g. one recorded from examples/nr_hashmap.py), the
+  timeline is derived from `append` events (appended ops bucketed by
+  second), so any runtime trace yields a timeline.
+- **stalls** — watchdog report: stall sites grouped by (where, log),
+  with fire counts, max fruitless rounds, and the dormant replicas seen.
+
+Pure stdlib on purpose: on a machine without jax, copy this file next
+to the trace and run it directly (`python report.py trace.jsonl`) —
+only the `-m` spelling pulls in the package __init__ (and with it jax).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter, defaultdict
+
+
+def _percentile(sorted_vals: list[float], p: float) -> float:
+    """Nearest-rank percentile on raw values (exact, not bucketed —
+    the trace carries every duration)."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(p * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.1f}us"
+
+
+def load_events(path: str) -> list[dict]:
+    events = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"# skipping malformed line {i}", file=sys.stderr)
+    return events
+
+
+def _event_time(e: dict, mono0: float | None,
+                ts0: float | None) -> float:
+    """Seconds since trace start. Monotonic and wall-clock stamps live
+    on different epochs, so each is measured against its OWN baseline —
+    mixing them (e.g. a legacy ts-only event next to upgraded events in
+    an appended-to trace file) would produce garbage offsets."""
+    if "mono" in e and mono0 is not None:
+        return float(e["mono"]) - mono0
+    if "ts" in e and ts0 is not None:
+        return float(e["ts"]) - ts0
+    return 0.0
+
+
+def analyze(events: list[dict]) -> dict:
+    """Reduce a trace to the report's structured form (the --json
+    payload; the text renderer consumes the same dict)."""
+    counts = Counter(e.get("event", "?") for e in events)
+
+    spans: dict[str, list[float]] = defaultdict(list)
+    fenced: dict[str, bool] = {}
+    for e in events:
+        if "duration_s" in e:
+            name = e.get("event", "?")
+            spans[name].append(float(e["duration_s"]))
+            fenced[name] = fenced.get(name, True) and bool(
+                e.get("fenced", False)
+            )
+    span_stats = {}
+    for name, vals in spans.items():
+        vals = sorted(vals)
+        span_stats[name] = {
+            "count": len(vals),
+            "total_s": sum(vals),
+            "p50_s": _percentile(vals, 0.50),
+            "p95_s": _percentile(vals, 0.95),
+            "p99_s": _percentile(vals, 0.99),
+            "max_s": vals[-1],
+            "fenced": fenced[name],
+        }
+
+    # throughput timeline: explicit per-second samples, else derive one
+    # from append events so every runtime trace has a timeline
+    monos = [float(e["mono"]) for e in events if "mono" in e]
+    tss = [float(e["ts"]) for e in events if "ts" in e]
+    mono0 = min(monos) if monos else None
+    ts0 = min(tss) if tss else None
+    timeline: dict[int, int] = defaultdict(int)
+    source = None
+    tp = [e for e in events if e.get("event") == "throughput"]
+    if tp:
+        source = "throughput"
+        for e in tp:
+            sec = e.get("second")
+            if sec is None or sec < 0:
+                sec = int(_event_time(e, mono0, ts0))
+            timeline[int(sec)] += int(e.get("ops", 0))
+    else:
+        appends = [e for e in events
+                   if e.get("event") == "append" and "n" in e]
+        if appends:
+            source = "append"
+            for e in appends:
+                timeline[int(_event_time(e, mono0, ts0))] += int(e["n"])
+
+    stalls: dict[tuple, dict] = {}
+    for e in events:
+        if e.get("event") != "watchdog":
+            continue
+        key = (e.get("where", "?"), e.get("log", None))
+        s = stalls.setdefault(
+            key, {"count": 0, "max_rounds": 0, "dormant": set(),
+                  "last_ltail": None, "last_tail": None}
+        )
+        s["count"] += 1
+        s["max_rounds"] = max(s["max_rounds"], int(e.get("rounds", 0)))
+        if "dormant" in e:
+            s["dormant"].add(int(e["dormant"]))
+        s["last_ltail"] = e.get("ltail", s["last_ltail"])
+        s["last_tail"] = e.get("tail", s["last_tail"])
+
+    return {
+        "n_events": len(events),
+        "event_counts": dict(counts),
+        "spans": span_stats,
+        "throughput": {
+            "source": source,
+            "timeline": dict(sorted(timeline.items())),
+        },
+        "stalls": [
+            {"where": where, "log": log, **{k: (sorted(v)
+                                               if isinstance(v, set)
+                                               else v)
+                                            for k, v in s.items()}}
+            for (where, log), s in sorted(stalls.items())
+        ],
+    }
+
+
+def render(report: dict, out=None) -> None:
+    # resolve sys.stdout at call time (an import-time default would pin
+    # whatever stream was active when the module first loaded)
+    w = (out if out is not None else sys.stdout).write
+    w(f"trace: {report['n_events']} events\n")
+
+    w("\n== event counts ==\n")
+    for name, n in sorted(report["event_counts"].items(),
+                          key=lambda kv: (-kv[1], kv[0])):
+        w(f"  {name:<20} {n}\n")
+
+    w("\n== span durations ==\n")
+    if not report["spans"]:
+        w("  (no spans recorded)\n")
+    else:
+        w(f"  {'span':<20} {'count':>6} {'p50':>10} {'p95':>10} "
+          f"{'p99':>10} {'max':>10} {'total':>10}  fenced\n")
+        for name, s in sorted(report["spans"].items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            w(f"  {name:<20} {s['count']:>6} {_fmt_s(s['p50_s']):>10} "
+              f"{_fmt_s(s['p95_s']):>10} {_fmt_s(s['p99_s']):>10} "
+              f"{_fmt_s(s['max_s']):>10} {_fmt_s(s['total_s']):>10}  "
+              f"{'yes' if s['fenced'] else 'NO'}\n")
+
+    w("\n== throughput timeline ==\n")
+    tl = report["throughput"]["timeline"]
+    if not tl:
+        w("  (no throughput samples and no append events)\n")
+    else:
+        src = report["throughput"]["source"]
+        if src == "append":
+            w("  (derived from append events: appended ops per second)\n")
+        peak = max(tl.values()) or 1
+        total = 0
+        for sec in sorted(int(s) for s in tl):
+            ops = tl[sec] if sec in tl else tl[str(sec)]
+            total += ops
+            bar = "#" * max(1, round(40 * ops / peak))
+            w(f"  t+{sec:>4}s {ops:>12} ops  {bar}\n")
+        w(f"  total {total} ops over {len(tl)} sampled second(s), "
+          f"peak {peak} ops/s\n")
+
+    w("\n== stall report ==\n")
+    if not report["stalls"]:
+        w("  (no watchdog events — no replay stalls observed)\n")
+    else:
+        for s in report["stalls"]:
+            where = s["where"] + (
+                f" [log {s['log']}]" if s["log"] is not None else ""
+            )
+            w(f"  {where}: {s['count']} warning(s), up to "
+              f"{s['max_rounds']} fruitless rounds; dormant replicas "
+              f"{s['dormant']}; last ltail/tail "
+              f"{s['last_ltail']}/{s['last_tail']}\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m node_replication_tpu.obs.report",
+        description="Summarize a flight-recorder JSONL trace.",
+    )
+    p.add_argument("trace", help="path to a JSONL trace "
+                                 "(NR_TPU_TRACE=<path> output)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as one JSON object instead of "
+                        "the text rendering")
+    args = p.parse_args(argv)
+    events = load_events(args.trace)
+    report = analyze(events)
+    try:
+        if args.json:
+            json.dump(report, sys.stdout, indent=2)
+            sys.stdout.write("\n")
+        else:
+            render(report)
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe: exit quietly, routing
+        # the interpreter-shutdown flush at devnull
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

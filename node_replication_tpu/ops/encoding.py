@@ -58,8 +58,35 @@ class Dispatch:
     (state, resps)` applies the plan's dense result per replica
     (elementwise, the honest per-replica replay work). Sound under the
     fused step's lock-step precondition (all replicas identical by
-    induction); divergent-state replay must use the scan path, exactly
-    as it already must for cursor catch-up.
+    induction).
+
+    STRENGTHENED CONTRACT for divergent cursors: providing this pair
+    also opts the model into the union-window catch-up tier —
+    `NodeReplicated(engine='auto')` and `log_catchup_all` route ANY
+    plan/merge model through `core/log.py:_catchup_union_plan`, which
+    merges the plan of the union window `[min(ltails), end)` (computed
+    from the most-lagging replica's state) into replicas that already
+    applied an arbitrary PREFIX of that window. Beyond the lock-step
+    precondition this requires:
+
+    - **prefix-absorbing plan**: for every split point p in the window,
+      merging `window_plan(state(m), W)` into `state(p)` (the fold of
+      the prefix `[m, p)`) must equal `state(end)` — cells the window
+      touches take the plan's final value regardless of how much of the
+      window the replica already applied, untouched cells keep the
+      replica's value;
+    - **canonical (state-independent) merge responses**: the per-position
+      responses `window_merge` reports must depend only on the plan
+      (equivalently: on the shared replay trajectory), never on the
+      merging replica's pre-merge state, because catch-up re-indexes the
+      donor plan's responses for every replica's own offsets.
+
+    A model whose plan/merge satisfies only the lock-step contract must
+    NOT provide the pair as-is: run it through `NodeReplicated(...,
+    engine='scan')`, or call `log_catchup_all(...,
+    on_trajectory=False)` for hand-built fleets, or supply only
+    `window_apply`. Differential coverage:
+    `tests/test_window.py::TestCombinedCatchup`.
 
     `window_apply` (optional) is the *combined replay* fast path:
     `(state, opcodes[W], args[W, A]) -> (state, resps[W])`, bit-identical

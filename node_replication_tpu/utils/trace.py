@@ -1,87 +1,18 @@
-"""Structured tracing: timestamped JSONL events + timing spans.
+"""Backward-compatible alias for the flight recorder.
 
-The reference's observability story is the `log` crate facade plus
-spin-loop diagnostics every WARN_THRESHOLD iterations
-(`nr/src/lib.rs:80-81`, `nr/src/log.rs:351-358`) and the harness's
-per-second throughput counters (`benches/mkbench.rs:755-761`). This module
-is the TPU build's equivalent: a process-wide `Tracer` that appends JSONL
-events (`{"ts", "event", ...fields}`) to a file or collects them in
-memory, plus a `span` context manager for timing named sections.
-
-Disabled by default (no overhead beyond one branch); enable with
-`NR_TPU_TRACE=<path>` or `get_tracer().enable(...)`.
+The structured tracer grew into the observability layer and lives in
+`node_replication_tpu/obs/recorder.py` (ring-buffered in-memory mode,
+monotonic timestamps, fence-accurate spans under NR_TPU_TRACE_FENCE=1);
+`obs/metrics.py` holds the process-wide metrics registry and
+`obs/report.py` the trace-report CLI. This module keeps the original
+import surface (`from node_replication_tpu.utils.trace import
+get_tracer, span`) working.
 """
 
-from __future__ import annotations
+from node_replication_tpu.obs.recorder import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    span,
+)
 
-import contextlib
-import json
-import os
-import threading
-import time
-from typing import Any
-
-
-class Tracer:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._fh = None
-        self._buffer: list[dict] | None = None
-        self.enabled = False
-
-    def enable(self, path: str | None = None) -> None:
-        """Write events to `path`, or buffer in memory when path is None."""
-        with self._lock:
-            if path:
-                self._fh = open(path, "a", buffering=1)
-                self._buffer = None
-            else:
-                self._fh = None
-                self._buffer = []
-            self.enabled = True
-
-    def disable(self) -> None:
-        with self._lock:
-            if self._fh:
-                self._fh.close()
-            self._fh = None
-            self._buffer = None
-            self.enabled = False
-
-    def emit(self, event: str, **fields: Any) -> None:
-        if not self.enabled:
-            return
-        rec = {"ts": time.time(), "event": event, **fields}
-        with self._lock:
-            if self._fh is not None:
-                self._fh.write(json.dumps(rec) + "\n")
-            elif self._buffer is not None:
-                self._buffer.append(rec)
-
-    def events(self) -> list[dict]:
-        """Buffered events (memory mode only)."""
-        with self._lock:
-            return list(self._buffer or [])
-
-
-_tracer = Tracer()
-if os.environ.get("NR_TPU_TRACE"):
-    _tracer.enable(os.environ["NR_TPU_TRACE"])
-
-
-def get_tracer() -> Tracer:
-    return _tracer
-
-
-@contextlib.contextmanager
-def span(event: str, **fields: Any):
-    """Time a section; emits `<event>` with `duration_s` on exit."""
-    t = _tracer
-    if not t.enabled:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        t.emit(event, duration_s=time.perf_counter() - t0, **fields)
+__all__ = ["Tracer", "get_tracer", "span"]

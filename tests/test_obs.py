@@ -1,0 +1,589 @@
+"""Observability layer tests: metrics registry, flight recorder,
+trace-report CLI, idle-round short-circuit, snapshot schemas, and the
+harness CSV schema upgrade.
+
+The cost contract under test (ISSUE 1 acceptance): with tracing and
+metrics disabled, the hot-path instrumentation pays at most one branch
+and allocates no event records — enforced here by poisoning the clock
+and JSON encoder on the disabled path.
+"""
+
+import csv
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from node_replication_tpu.core.cnr import MultiLogReplicated
+from node_replication_tpu.core.log import (
+    LogSpec,
+    log_append,
+    log_catchup_all,
+    log_init,
+)
+from node_replication_tpu.core.replica import (
+    NodeReplicated,
+    replicate_state,
+)
+from node_replication_tpu.models import HM_GET, HM_PUT, make_hashmap
+from node_replication_tpu.obs.metrics import (
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from node_replication_tpu.obs.recorder import Tracer, get_tracer, span
+from node_replication_tpu.ops.encoding import encode_ops
+
+
+@pytest.fixture
+def reg():
+    """A private enabled registry (keeps the global one untouched)."""
+    r = MetricsRegistry(enabled=True)
+    yield r
+
+
+@pytest.fixture
+def global_metrics():
+    """Enable the global registry for wrapper tests; restore after."""
+    r = get_registry()
+    was = r.enabled
+    r.enable()
+    yield r
+    r.enabled = was
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_basics(self, reg):
+        c = reg.counter("c")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        g = reg.gauge("g")
+        g.set(2.5)
+        assert g.value == 2.5
+        assert reg.counter("c") is c  # get-or-create returns the handle
+
+    def test_kind_conflict_raises(self, reg):
+        reg.counter("x")
+        with pytest.raises(TypeError, match="already registered"):
+            reg.gauge("x")
+
+    def test_disabled_is_inert(self):
+        r = MetricsRegistry(enabled=False)
+        c = r.counter("c")
+        h = r.histogram("h")
+        g = r.gauge("g")
+        c.inc(100)
+        h.observe(1.0)
+        g.set(9)
+        assert c.value == 0 and h.count == 0 and g.value == 0.0
+
+    def test_reset_keeps_handles(self, reg):
+        c = reg.counter("c")
+        c.inc(7)
+        reg.reset()
+        assert c.value == 0
+        c.inc()
+        assert c.value == 1
+
+    def test_snapshot_skips_untouched(self, reg):
+        reg.counter("touched").inc()
+        reg.counter("untouched")
+        snap = reg.snapshot()
+        assert snap == {"touched": 1}
+
+    def test_threaded_counter_increments(self, reg):
+        c = reg.counter("c")
+        N, T = 5000, 8
+
+        def work():
+            for _ in range(N):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(T)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == N * T
+
+
+class TestHistogramPercentiles:
+    def test_known_distribution(self, reg):
+        h = reg.histogram("h", buckets=(1.0, 2.0, 4.0, 8.0))
+        for _ in range(50):
+            h.observe(0.5)
+        for _ in range(50):
+            h.observe(3.0)
+        assert h.count == 100
+        assert h.sum == pytest.approx(175.0)
+        # p50 lands at the first bucket's upper edge; p95 interpolates
+        # inside (2, 4] and clamps to the observed max
+        assert h.percentile(0.50) == pytest.approx(1.0)
+        assert h.percentile(0.95) == pytest.approx(3.0)
+        assert h.percentile(1.0) == pytest.approx(3.0)
+        assert h.percentile(0.0) == pytest.approx(0.5)  # clamps to min
+
+    def test_overflow_bucket(self, reg):
+        h = reg.histogram("h", buckets=(1.0,))
+        h.observe(100.0)
+        h.observe(200.0)
+        assert h.percentile(0.99) <= 200.0
+        assert h.percentile(0.99) >= 100.0
+
+    def test_empty(self, reg):
+        h = reg.histogram("h")
+        assert h.percentile(0.5) == 0.0
+        assert h._snapshot() == {"count": 0, "sum": 0.0}
+
+    def test_bad_buckets_raise(self, reg):
+        with pytest.raises(ValueError, match="ascend"):
+            Histogram("bad", reg, buckets=(2.0, 1.0))
+        with pytest.raises(ValueError, match="outside"):
+            reg.histogram("h").percentile(1.5)
+
+
+class TestFlightRecorder:
+    def test_ring_buffer_keeps_last_n(self):
+        t = Tracer()
+        t.enable(None, ring=3)
+        for i in range(7):
+            t.emit("e", i=i)
+        assert [e["i"] for e in t.events()] == [4, 5, 6]
+        t.disable()
+
+    def test_monotonic_timestamps(self):
+        t = Tracer()
+        t.enable(None)
+        for i in range(5):
+            t.emit("e", i=i)
+        monos = [e["mono"] for e in t.events()]
+        assert monos == sorted(monos)
+        assert all("ts" in e for e in t.events())
+        t.disable()
+
+    def test_enable_disable_race_is_safe(self):
+        t = Tracer()
+        stop = threading.Event()
+        errors = []
+
+        def emitter():
+            while not stop.is_set():
+                try:
+                    t.emit("e", x=1)
+                except Exception as ex:  # pragma: no cover
+                    errors.append(ex)
+
+        threads = [threading.Thread(target=emitter) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for _ in range(300):
+            t.enable(None)
+            t.disable()
+        stop.set()
+        for th in threads:
+            th.join()
+        assert not errors
+        assert t.events() == []
+
+    def test_fence_accurate_span_mode(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(
+            "node_replication_tpu.utils.fence.fence",
+            lambda *trees: calls.append(trees),
+        )
+        t = get_tracer()
+        t.enable(None)
+        monkeypatch.setattr(t, "fence_spans", True)
+        try:
+            with span("fenced-section", tag=1) as sp:
+                sp.fence("log", "states")
+            with span("unfenced-section"):
+                pass
+        finally:
+            t.fence_spans = False
+            events = t.events()
+            t.disable()
+        assert calls == [("log", "states")]
+        fe = next(e for e in events if e["event"] == "fenced-section")
+        assert fe["fenced"] is True and fe["tag"] == 1
+        ue = next(e for e in events if e["event"] == "unfenced-section")
+        assert ue["fenced"] is False  # no fence target registered
+
+    def test_span_add_fields(self):
+        t = get_tracer()
+        t.enable(None)
+        try:
+            with span("s", a=1) as sp:
+                sp.add(b=2)
+            e = t.events()[-1]
+        finally:
+            t.disable()
+        assert e["a"] == 1 and e["b"] == 2 and "duration_s" in e
+
+
+class TestDisabledPathAllocatesNothing:
+    """The acceptance-criterion cost contract: disabled tracer/registry
+    hot paths never read the clock, never touch the JSON encoder, and
+    never build an event record."""
+
+    def test_no_clock_no_record(self, monkeypatch):
+        if os.environ.get("NR_TPU_TRACE"):
+            pytest.skip("tracer force-enabled via NR_TPU_TRACE")
+        t = get_tracer()
+        assert not t.enabled
+        r = get_registry()
+        was = r.enabled
+        r.disable()
+        c = r.counter("test.noalloc.c")
+        h = r.histogram("test.noalloc.h")
+        import node_replication_tpu.obs.recorder as rec
+
+        def boom(*a, **k):  # pragma: no cover - must never run
+            raise AssertionError("disabled path did observable work")
+
+        monkeypatch.setattr(rec.time, "time", boom)
+        monkeypatch.setattr(rec.time, "monotonic", boom)
+        monkeypatch.setattr(rec.time, "perf_counter", boom)
+        monkeypatch.setattr(rec.json, "dumps", boom)
+        try:
+            t.emit("nope", x=1)
+            with span("nope", y=2) as sp:
+                sp.add(z=3)
+                sp.fence(object())
+            c.inc(10)
+            h.observe(1.0)
+        finally:
+            r.enabled = was
+        assert t.events() == []
+        assert c.value == 0 and h.count == 0
+
+
+class TestIdleRoundShortCircuit:
+    def test_nr_idle_rounds_skip_device(self, global_metrics):
+        nr = NodeReplicated(
+            make_hashmap(16), n_replicas=2, log_entries=512, gc_slack=16
+        )
+        tok = nr.register(0)
+        assert nr.execute_mut((HM_PUT, 1, 7), tok) == 0
+        nr.sync()
+        before = nr.stats()
+
+        def boom(*a, **k):  # pragma: no cover - must never run
+            raise AssertionError("device exec dispatched on idle round")
+
+        nr._exec_jit = boom
+        nr.flush()  # empty combine "help" round
+        nr.flush()
+        assert nr.execute((HM_GET, 1), tok) == 7  # read-sync poll
+        after = nr.stats()
+        assert after["idle_rounds"] >= before["idle_rounds"] + 2
+        assert after["exec_rounds"] == before["exec_rounds"]
+
+    def test_cnr_idle_rounds_skip_device(self):
+        c = MultiLogReplicated(
+            make_hashmap(16), lambda o, a: a[0], nlogs=2, n_replicas=1,
+            log_entries=1 << 10, gc_slack=32,
+        )
+        tok = c.register(0)
+        c.execute_mut((HM_PUT, 1, 5), tok)
+        c.sync()
+        before = c.stats()
+
+        def boom(*a, **k):  # pragma: no cover - must never run
+            raise AssertionError("device exec dispatched on idle round")
+
+        c._exec_jit = boom
+        c.combine(0, 0)  # nothing staged on log 0
+        c.combine(0, 1)
+        after = c.stats()
+        assert after["idle_rounds"] >= before["idle_rounds"] + 2
+        assert after["exec_rounds"] == before["exec_rounds"]
+
+    def test_union_plan_eager_idle_skip(self, global_metrics):
+        d = make_hashmap(16)  # provides window_plan/window_merge
+        spec = LogSpec(capacity=256, n_replicas=2, gc_slack=8)
+        log = log_init(spec)
+        states = replicate_state(d.init_state(), 2)
+        opc, args, n = encode_ops([(HM_PUT, k, k) for k in range(4)], 3)
+        log = log_append(spec, log, opc, args, n)
+        log, states, _ = log_catchup_all(spec, d, log, states, 8)
+        assert int(np.asarray(log.ltails).min()) == int(log.tail)
+
+        skip = global_metrics.counter("log.engine.idle_skip")
+        v0 = skip.value
+        log2, states2, resps = log_catchup_all(spec, d, log, states, 8)
+        # the idle call returned the inputs untouched and paid no plan
+        assert log2 is log and states2 is states
+        assert resps.shape == (2, 8)
+        assert not np.asarray(resps).any()
+        assert skip.value == v0 + 1
+
+
+class TestSnapshotSchemas:
+    def test_nr_stats_and_snapshot(self, global_metrics):
+        nr = NodeReplicated(
+            make_hashmap(32), n_replicas=2, log_entries=512, gc_slack=16
+        )
+        tok = nr.register(0)
+        for i in range(5):
+            nr.execute_mut((HM_PUT, i, i), tok)
+        s = nr.stats()
+        # legacy keys stay stable for existing consumers
+        for k in ("appended", "head", "ctail", "min_ltail",
+                  "exec_rounds"):
+            assert k in s, k
+        assert s["idle_rounds"] >= 0
+        assert s["engine"] in ("combined", "scan")
+        snap = nr.snapshot()
+        json.dumps(snap)  # JSON-safe throughout
+        assert set(snap) == {"log", "replicas", "exec", "metrics"}
+        assert snap["log"]["tail"] == 5
+        assert 0.0 <= snap["log"]["occupancy"] <= 1.0
+        assert snap["replicas"]["n"] == 2
+        assert snap["replicas"]["lag"] == [0, 0]
+        assert snap["replicas"]["threads"] == [1, 0]
+        assert snap["exec"]["engine"] == nr.engine
+        assert snap["exec"]["rounds"] == s["exec_rounds"]
+        assert "nr.combine.batch_size" in snap["metrics"]
+
+    def test_cnr_stats_and_snapshot(self, global_metrics):
+        c = MultiLogReplicated(
+            make_hashmap(64), lambda o, a: a[0], nlogs=4, n_replicas=1,
+            log_entries=1 << 10, gc_slack=32,
+        )
+        tok = c.register(0)
+        for k in range(16):
+            c.execute_mut((HM_PUT, k, k), tok)
+        s = c.stats()
+        assert s["tails"] == [4, 4, 4, 4]  # legacy key stable
+        assert s["log_selected"] == [4, 4, 4, 4]
+        assert s["combine_rounds"] == [4, 4, 4, 4]
+        snap = c.snapshot()
+        json.dumps(snap)
+        assert snap["nlogs"] == 4
+        assert len(snap["logs"]) == 4
+        assert snap["selection_imbalance"] == pytest.approx(1.0)
+        for lg in snap["logs"]:
+            assert lg["tail"] == 4 and lg["max_lag"] == 0
+        assert snap["exec"]["rounds"] == s["exec_rounds"]
+
+
+class TestReportCLI:
+    def _record_trace(self, path):
+        t = get_tracer()
+        t.enable(str(path))
+        try:
+            nr = NodeReplicated(
+                make_hashmap(16), n_replicas=2, log_entries=512,
+                gc_slack=16,
+            )
+            tok = nr.register(0)
+            for i in range(5):
+                nr.execute_mut((HM_PUT, i, i), tok)
+            t.emit("throughput", second=0, ops=100)
+            t.emit("throughput", second=1, ops=200)
+            t.emit("watchdog", where="sync", rounds=64, dormant=1,
+                   ltail=0, tail=5)
+        finally:
+            t.disable()
+
+    def test_roundtrip_text(self, tmp_path, capsys):
+        from node_replication_tpu.obs import report
+
+        path = tmp_path / "trace.jsonl"
+        self._record_trace(path)
+        assert report.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "event counts" in out
+        assert "append" in out and "combine-replay" in out
+        assert "p50" in out and "p95" in out and "p99" in out
+        assert "throughput timeline" in out
+        assert "300 ops over 2 sampled second(s)" in out
+        assert "stall report" in out
+        assert "sync: 1 warning(s), up to 64 fruitless rounds" in out
+
+    def test_roundtrip_json(self, tmp_path, capsys):
+        from node_replication_tpu.obs import report
+
+        path = tmp_path / "trace.jsonl"
+        self._record_trace(path)
+        assert report.main([str(path), "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["event_counts"]["append"] == 5
+        assert data["spans"]["append"]["count"] == 5
+        assert data["spans"]["append"]["p99_s"] >= data["spans"][
+            "append"]["p50_s"]
+        assert data["throughput"]["source"] == "throughput"
+        assert data["throughput"]["timeline"] == {"0": 100, "1": 200}
+        assert data["stalls"][0]["where"] == "sync"
+        assert data["stalls"][0]["dormant"] == [1]
+
+    def test_timeline_derived_from_appends(self, tmp_path, capsys):
+        from node_replication_tpu.obs import report
+
+        path = tmp_path / "trace.jsonl"
+        t = get_tracer()
+        t.enable(str(path))
+        try:
+            nr = NodeReplicated(
+                make_hashmap(16), n_replicas=1, log_entries=512,
+                gc_slack=16,
+            )
+            tok = nr.register(0)
+            for i in range(4):
+                nr.execute_mut((HM_PUT, i, i), tok)
+        finally:
+            t.disable()
+        assert report.main([str(path), "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["throughput"]["source"] == "append"
+        assert sum(data["throughput"]["timeline"].values()) == 4
+
+    def test_timeline_with_legacy_ts_only_events(self, tmp_path,
+                                                 capsys):
+        # a trace file appended to across the tracer upgrade holds
+        # ts-only events next to mono-stamped ones; each kind must be
+        # bucketed against its OWN epoch, not a mixed baseline
+        from node_replication_tpu.obs import report
+
+        path = tmp_path / "trace.jsonl"
+        path.write_text(
+            '{"ts": 1754000000.0, "event": "throughput", "ops": 50}\n'
+            '{"ts": 1754000001.0, "mono": 5.0, "event": "throughput",'
+            ' "ops": 100, "second": -1}\n'
+        )
+        assert report.main([str(path), "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        tl = data["throughput"]["timeline"]
+        assert sum(tl.values()) == 150
+        assert all(int(sec) <= 2 for sec in tl)  # no cross-epoch offset
+
+    def test_malformed_lines_skipped(self, tmp_path, capsys):
+        from node_replication_tpu.obs import report
+
+        path = tmp_path / "trace.jsonl"
+        path.write_text(
+            '{"ts": 1.0, "mono": 1.0, "event": "ok"}\n'
+            "not json\n"
+            '{"ts": 2.0, "mono": 2.0, "event": "ok"}\n'
+        )
+        assert report.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "2 events" in out
+
+
+class TestHarnessTraceThroughput:
+    def test_measure_emits_per_second_samples(self):
+        from node_replication_tpu.harness.mkbench import (
+            measure_step_runner,
+        )
+        from node_replication_tpu.harness.trait import ReplicatedRunner
+        from node_replication_tpu.harness.workloads import (
+            WorkloadSpec,
+            generate_batches,
+        )
+
+        t = get_tracer()
+        t.enable(None)
+        try:
+            gen = generate_batches(WorkloadSpec(keyspace=32), 4, 2, 2, 2)
+            res = measure_step_runner(
+                ReplicatedRunner(make_hashmap(32), 2, 2, 2), *gen,
+                duration_s=0.1,
+            )
+            tp = [e for e in t.events() if e["event"] == "throughput"]
+        finally:
+            t.disable()
+        assert tp, "measure_step_runner emitted no throughput samples"
+        assert sum(e["ops"] for e in tp) == res.total_client_ops
+        assert all(e["second"] >= 0 for e in tp)
+
+
+class TestCsvSchemaUpgrade:
+    FIELDS = ["a", "b", "c"]
+
+    def _read(self, path):
+        with open(path, newline="") as f:
+            r = csv.reader(f)
+            return next(r), [row for row in r]
+
+    def test_reordered_same_set_header_rewritten(self, tmp_path):
+        from node_replication_tpu.harness.mkbench import _append_csv
+
+        path = str(tmp_path / "x.csv")
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["b", "a", "c"])  # same set, different order
+            w.writerow([2, 1, 3])
+        _append_csv(path, self.FIELDS, [{"a": 4, "b": 5, "c": 6}])
+        header, rows = self._read(path)
+        assert header == self.FIELDS
+        assert rows == [["1", "2", "3"], ["4", "5", "6"]]
+
+    def test_removed_column_dropped_on_rewrite(self, tmp_path):
+        from node_replication_tpu.harness.mkbench import _append_csv
+
+        path = str(tmp_path / "x.csv")
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["a", "b", "zz"])  # zz no longer in the schema
+            w.writerow([1, 2, 9])
+        _append_csv(path, self.FIELDS, [{"a": 4, "b": 5, "c": 6}])
+        header, rows = self._read(path)
+        assert header == self.FIELDS
+        assert rows == [["1", "2", ""], ["4", "5", "6"]]
+
+    def test_subset_header_upgraded(self, tmp_path):
+        from node_replication_tpu.harness.mkbench import _append_csv
+
+        path = str(tmp_path / "x.csv")
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["a", "b"])
+            w.writerow([1, 2])
+        _append_csv(path, self.FIELDS, [{"a": 4, "b": 5, "c": 6}])
+        header, rows = self._read(path)
+        assert header == self.FIELDS
+        assert rows == [["1", "2", ""], ["4", "5", "6"]]
+
+    def test_matching_header_appends_in_place(self, tmp_path):
+        from node_replication_tpu.harness.mkbench import _append_csv
+
+        path = str(tmp_path / "x.csv")
+        _append_csv(path, self.FIELDS, [{"a": 1, "b": 2, "c": 3}])
+        _append_csv(path, self.FIELDS, [{"a": 4, "b": 5, "c": 6}])
+        header, rows = self._read(path)
+        assert header == self.FIELDS
+        assert rows == [["1", "2", "3"], ["4", "5", "6"]]
+
+
+class TestInstrumentedCorrectness:
+    """Tracing + metrics + fence-span mode enabled must not change any
+    result (the CI traced shard proves this at suite scale; this is the
+    in-repo guard)."""
+
+    def test_full_observability_on(self, global_metrics, monkeypatch):
+        t = get_tracer()
+        t.enable(None)
+        monkeypatch.setattr(t, "fence_spans", True)
+        try:
+            nr = NodeReplicated(
+                make_hashmap(32), n_replicas=2, log_entries=512,
+                gc_slack=16,
+            )
+            tok = nr.register(0)
+            for i in range(10):
+                assert nr.execute_mut((HM_PUT, i, i * 3), tok) == 0
+            for i in range(10):
+                assert nr.execute((HM_GET, i), tok) == i * 3
+            nr.sync()
+            assert nr.replicas_equal()
+            spans = [e for e in t.events() if "duration_s" in e]
+            assert any(e["event"] == "exec-round" and e["fenced"]
+                       for e in spans)
+        finally:
+            t.fence_spans = False
+            t.disable()
