@@ -1,0 +1,40 @@
+"""nrlint: project-native static analysis for the TPU node-replication port.
+
+The reference compiles its invariants into every build as `panic!`s
+(`nr/src/log.rs:487-489`, `nr/src/context.rs:145-148`); compiled XLA code
+cannot panic, so this port's equivalents are *conventions* — checkify
+wrappers (`utils/checks.py`), "no host sync inside the hot path", "obs
+calls never inside traced code", "ring indices are masked" — that nothing
+used to enforce. This package is the machine-checked gate: an AST-based
+lint over the project's own idioms, run as a required CI job.
+
+    python -m node_replication_tpu.analysis.lint node_replication_tpu/
+
+Layout:
+
+- `astutil.py` — parsing, suppression comments, import/alias resolution,
+  and the traced-closure inference (which functions execute under
+  `jax.jit`/`vmap`/`lax.*`/`pallas_call` tracing, directly or through the
+  project call graph / `Dispatch` registration).
+- `rules.py` — the rule registry and every shipped rule.
+- `lint.py` — the engine + CLI (`file:line:col: rule-id severity:
+  message` diagnostics, `--min-severity`, `--list-rules`).
+
+Suppress a diagnostic with a trailing (or immediately-preceding-line)
+comment: `# nrlint: disable=<rule-id>[,<rule-id>...] — justification`.
+That exact form is the ONLY one that suppresses: unknown rule ids and
+malformed `# nrlint` comments are themselves diagnosed
+(`unknown-suppression`) so typos cannot silently disarm the gate.
+"""
+
+__all__ = ["run_lint"]
+
+
+def __getattr__(name):
+    # lazy: `python -m node_replication_tpu.analysis.lint` would warn
+    # about double-import if the package eagerly imported the submodule
+    if name == "run_lint":
+        from node_replication_tpu.analysis.lint import run_lint
+
+        return run_lint
+    raise AttributeError(name)

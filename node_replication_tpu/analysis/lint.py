@@ -1,0 +1,184 @@
+"""nrlint engine + CLI.
+
+    python -m node_replication_tpu.analysis.lint <paths> [options]
+
+Parses every `.py` under the given paths, builds the project-wide
+context (traced closure, Dispatch registrations — `astutil.py`), runs
+every registered rule (`rules.py`), and prints
+`file:line:col: rule-id severity: message` diagnostics.
+
+Exit status: 0 when no unsuppressed diagnostic at or above
+`--min-severity` (default `warning`) remains, 1 otherwise — the CI
+gate. Suppressions (`# nrlint: disable=<rule>[,<rule>]` on the
+diagnostic's line or the line directly above) keep the diagnostic
+visible with `--show-suppressed` but never fail the run; a suppression
+naming an unknown rule id is itself a `unknown-suppression` warning so
+typos cannot disarm the gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Iterable
+
+from node_replication_tpu.analysis.astutil import (
+    Diagnostic,
+    ModuleInfo,
+    Project,
+)
+from node_replication_tpu.analysis.rules import (
+    RULES,
+    SEVERITY_ORDER,
+    WARNING,
+)
+
+
+def collect_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if not d.startswith(".") and d != "__pycache__"
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+    return out
+
+
+def _suppressed_by(mod: ModuleInfo, diag: Diagnostic) -> bool:
+    for line in (diag.line, diag.line - 1):
+        rules = mod.suppressions.get(line)
+        if rules and diag.rule_id in rules:
+            return True
+    return False
+
+
+def run_lint(
+    paths: Iterable[str],
+    select: set[str] | None = None,
+) -> tuple[list[Diagnostic], list[str]]:
+    """Run every (or the selected) rule over `paths`.
+
+    Returns `(diagnostics, errors)`: diagnostics carry a `suppressed`
+    flag already resolved against the source comments; `errors` are
+    files that failed to parse (themselves a gate failure).
+    """
+    errors: list[str] = []
+    modules: list[ModuleInfo] = []
+    for path in collect_files(paths):
+        try:
+            modules.append(ModuleInfo(path))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(f"{path}: {e}")
+    project = Project(modules)
+    diags: list[Diagnostic] = []
+    for mod in modules:
+        for rule in RULES.values():
+            if select and rule.id not in select:
+                continue
+            for d in rule.check(mod, project):
+                d.suppressed = _suppressed_by(mod, d)
+                diags.append(d)
+        # meta-checks: a typo'd suppression must never silently disarm
+        # the gate — unknown rule names and malformed suppression
+        # comments are both diagnosed
+        for line, names in sorted(mod.suppressions.items()):
+            for name in sorted(names):
+                if name not in RULES:
+                    diags.append(Diagnostic(
+                        path=mod.path, line=line, col=1,
+                        rule_id="unknown-suppression",
+                        severity=WARNING,
+                        message=(
+                            f"suppression names unknown rule "
+                            f"{name!r} (known: "
+                            f"{', '.join(sorted(RULES))})"
+                        ),
+                    ))
+        for line in mod.malformed_suppressions:
+            diags.append(Diagnostic(
+                path=mod.path, line=line, col=1,
+                rule_id="unknown-suppression",
+                severity=WARNING,
+                message=(
+                    "malformed nrlint comment (suppresses nothing); "
+                    "the only recognized form is "
+                    "`# nrlint: disable=<rule>[,<rule>]`"
+                ),
+            ))
+    diags.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+    return diags, errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m node_replication_tpu.analysis.lint",
+        description=(
+            "nrlint: project-native static analysis (trace hygiene, "
+            "combiner lock discipline, ring-cursor safety)"
+        ),
+    )
+    ap.add_argument("paths", nargs="*", default=["node_replication_tpu"],
+                    help="files or directories (default: the package)")
+    ap.add_argument("--min-severity", default=WARNING,
+                    choices=sorted(SEVERITY_ORDER, key=SEVERITY_ORDER.get),
+                    help="fail threshold (default: warning)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed diagnostics")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(r) for r in RULES)
+        for rid, r in sorted(RULES.items()):
+            print(f"{rid:<{width}}  {r.severity:<7}  {r.summary}")
+        return 0
+
+    select = (
+        {s.strip() for s in args.select.split(",") if s.strip()}
+        if args.select else None
+    )
+    if select:
+        unknown = select - set(RULES)
+        if unknown:
+            print(f"nrlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    files = collect_files(args.paths)
+    diags, errors = run_lint(files, select=select)
+    for e in errors:
+        print(f"parse error: {e}")
+
+    threshold = SEVERITY_ORDER[args.min_severity]
+    failing = [
+        d for d in diags
+        if not d.suppressed and SEVERITY_ORDER[d.severity] >= threshold
+    ]
+    shown = failing if not args.show_suppressed else [
+        d for d in diags if SEVERITY_ORDER[d.severity] >= threshold
+    ]
+    for d in shown:
+        print(d.format())
+
+    n_suppressed = sum(1 for d in diags if d.suppressed)
+    print(
+        f"nrlint: {len(failing)} failing diagnostic(s), "
+        f"{n_suppressed} suppressed, {len(diags)} total "
+        f"across {len(files)} file(s)"
+    )
+    return 1 if failing or errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

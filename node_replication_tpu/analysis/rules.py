@@ -1,0 +1,703 @@
+"""nrlint rule registry + every shipped rule.
+
+Each rule is a function `(mod: ModuleInfo, project: Project) ->
+Iterable[Diagnostic]` registered with `@rule(id, severity, summary)`.
+Rule ids are kebab-case and stable: they are the suppression currency
+(`# nrlint: disable=<id>`), so renaming one invalidates suppressions.
+
+The rules encode PROJECT invariants, not general Python style — each
+docstring says which convention it machine-checks and where that
+convention is documented.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Callable, Iterable, Iterator
+
+from node_replication_tpu.analysis.astutil import (
+    Diagnostic,
+    ModuleInfo,
+    PROJECT_PACKAGE,
+    Project,
+)
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+SEVERITY_ORDER = {INFO: 0, WARNING: 1, ERROR: 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    summary: str
+    check: Callable[[ModuleInfo, Project], Iterable[Diagnostic]]
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(rule_id: str, severity: str, summary: str):
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        RULES[rule_id] = Rule(rule_id, severity, summary, fn)
+        return fn
+
+    return deco
+
+
+def _diag(mod: ModuleInfo, node: ast.AST, rule_id: str,
+          message: str) -> Diagnostic:
+    return Diagnostic(
+        path=mod.path,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1,
+        rule_id=rule_id,
+        severity=RULES[rule_id].severity,
+        message=message,
+    )
+
+
+def _receiver_tail(expr: ast.AST) -> str | None:
+    """Last component of a receiver expression: `self._m_batch` ->
+    `_m_batch`, `tracer` -> `tracer`. A ternary receiver reports
+    whichever arm matches ((_m_a if c else _m_b).inc())."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.IfExp):
+        return _receiver_tail(expr.body) or _receiver_tail(expr.orelse)
+    return None
+
+
+def _base_name(expr: ast.AST) -> str | None:
+    """Innermost Name of an attribute/subscript chain."""
+    cur = expr
+    while isinstance(cur, (ast.Attribute, ast.Subscript)):
+        cur = cur.value
+    return cur.id if isinstance(cur, ast.Name) else None
+
+
+def _through_at(expr: ast.AST) -> bool:
+    """Chain passes through `.at` — jnp's FUNCTIONAL update protocol
+    (`x.at[i].add(v)` returns a new array, it mutates nothing)."""
+    cur = expr
+    while isinstance(cur, (ast.Attribute, ast.Subscript)):
+        if isinstance(cur, ast.Attribute) and cur.attr == "at":
+            return True
+        cur = cur.value
+    return False
+
+
+# --------------------------------------------------------------------------
+# host-sync-in-jit
+# --------------------------------------------------------------------------
+
+_HOST_SYNC_DOTTED = {
+    "jax.device_get": "jax.device_get forces a device->host transfer",
+    "jax.block_until_ready": "blocking on device values",
+    "numpy.asarray": "np.asarray materializes the array on host",
+    "numpy.array": "np.array materializes the array on host",
+}
+_HOST_SYNC_METHODS = {
+    "item": ".item() is a device->host scalar readback",
+    "block_until_ready": ".block_until_ready() blocks on device work",
+}
+
+
+@rule(
+    "host-sync-in-jit", ERROR,
+    "device->host sync inside traced (jit/vmap/lax/pallas) code",
+)
+def host_sync_in_jit(mod: ModuleInfo,
+                     project: Project) -> Iterator[Diagnostic]:
+    """The hot-path contract (BENCH_NOTES methodology, `utils/fence.py`):
+    no host synchronization inside traced code. `.item()`,
+    `np.asarray`, `jax.device_get`, `block_until_ready` either fail at
+    trace time or silently constant-fold one trace-time value into the
+    compiled program. Host readbacks belong in the host-side loops
+    (`NodeReplicated._exec_round`), never in functions reachable from
+    `jax.jit`/`_build_jits`. An `isinstance(..., jax.core.Tracer)`
+    guard marks an explicit eager-only region and is exempt."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if project.traced_context(mod, node) is None:
+            continue
+        if mod.in_eager_guard(node):
+            continue
+        d = mod.dotted(node.func)
+        if d in _HOST_SYNC_DOTTED:
+            yield _diag(
+                mod, node, "host-sync-in-jit",
+                f"{d}() inside traced code: "
+                f"{_HOST_SYNC_DOTTED[d]}; traced values must stay on "
+                f"device (use jnp, or hoist to the host loop)",
+            )
+        elif (
+            d is None
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HOST_SYNC_METHODS
+        ):
+            yield _diag(
+                mod, node, "host-sync-in-jit",
+                f".{node.func.attr}() inside traced code: "
+                f"{_HOST_SYNC_METHODS[node.func.attr]}; hoist to the "
+                f"host loop or keep the value symbolic",
+            )
+
+
+# --------------------------------------------------------------------------
+# scalar-cast-in-jit
+# --------------------------------------------------------------------------
+
+
+@rule(
+    "scalar-cast-in-jit", ERROR,
+    "int()/float()/bool() on a non-constant inside traced code",
+)
+def scalar_cast_in_jit(mod: ModuleInfo,
+                       project: Project) -> Iterator[Diagnostic]:
+    """`int(x)`/`float(x)`/`bool(x)` on a traced array is a concretization
+    error at trace time (`TracerBoolConversionError` and friends) — or,
+    on a trace-time-constant, silently bakes one value into the
+    compiled program. Use `jnp.int32(...)`-style casts (stay symbolic)
+    or hoist the readback to host code. Constant literals are fine."""
+    for node in ast.walk(mod.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("int", "float", "bool")
+            and node.args
+        ):
+            continue
+        if project.traced_context(mod, node) is None:
+            continue
+        if mod.in_eager_guard(node):
+            continue
+        a = node.args[0]
+        if isinstance(a, ast.Constant) or (
+            isinstance(a, ast.UnaryOp)
+            and isinstance(a.operand, ast.Constant)
+        ):
+            continue
+        yield _diag(
+            mod, node, "scalar-cast-in-jit",
+            f"{node.func.id}() on a non-constant inside traced code "
+            f"concretizes a tracer (raises or constant-folds); use a "
+            f"jnp dtype cast or hoist to the host loop",
+        )
+
+
+# --------------------------------------------------------------------------
+# raw-checkify-check
+# --------------------------------------------------------------------------
+
+
+@rule(
+    "raw-checkify-check", ERROR,
+    "checkify.check() used directly instead of utils.checks.check",
+)
+def raw_checkify_check(mod: ModuleInfo,
+                       project: Project) -> Iterator[Diagnostic]:
+    """A live `checkify.check` inside a jit that was never
+    `checked()`-functionalized is a trace-time crash (see
+    `utils/checks.py`). The project convention is `utils.checks.check`,
+    which is armed only inside `debug_checks(True)` so release traces
+    are bit-identical to the unchecked program. Direct `checkify.check`
+    calls bypass that zero-cost-off contract."""
+    if mod.path.replace("\\", "/").endswith("utils/checks.py"):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if mod.dotted(node.func) == "jax.experimental.checkify.check":
+            yield _diag(
+                mod, node, "raw-checkify-check",
+                "raw checkify.check() bypasses the debug_checks() "
+                "arming contract; use node_replication_tpu.utils."
+                "checks.check (zero cost when disarmed)",
+            )
+
+
+# --------------------------------------------------------------------------
+# obs-in-traced
+# --------------------------------------------------------------------------
+
+_OBS_FACTORIES = ("get_tracer", "get_registry", "span")
+_OBS_METHODS = ("emit", "inc", "observe")
+_OBS_RECEIVER_RE = re.compile(r"(^_?m_|_m_|tracer|metric|recorder)",
+                              re.IGNORECASE)
+
+
+@rule(
+    "obs-in-traced", ERROR,
+    "tracer/metrics call reachable from traced code",
+)
+def obs_in_traced(mod: ModuleInfo,
+                  project: Project) -> Iterator[Diagnostic]:
+    """Tracer and metrics calls (`obs.*`) are host-side: inside traced
+    code they run once per TRACE (not per step) and their locks/IO have
+    no device equivalent — silent no-ops at best, counter lies at
+    worst. Instrument the host loops (`_exec_round`, `combine`), never
+    functions reachable from jit. Deliberate per-trace counters (the
+    `core/log.py` engine-dispatch family) carry justified
+    suppressions."""
+    if f"{PROJECT_PACKAGE}.obs" in mod.module_name:
+        return  # the obs layer itself is host-side by construction
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if project.traced_context(mod, node) is None:
+            continue
+        if mod.in_eager_guard(node):
+            continue
+        d = mod.dotted(node.func)
+        if d and d.startswith(PROJECT_PACKAGE) and (
+            d.rsplit(".", 1)[-1] in _OBS_FACTORIES
+        ):
+            yield _diag(
+                mod, node, "obs-in-traced",
+                f"{d.rsplit('.', 1)[-1]}() inside traced code runs "
+                f"once per trace, not per step; move it to the host "
+                f"loop",
+            )
+            continue
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _OBS_METHODS
+        ):
+            continue
+        recv = node.func.value
+        tail = _receiver_tail(recv)
+        if isinstance(recv, ast.Call):
+            rd = mod.dotted(recv.func)
+            if rd and rd.rsplit(".", 1)[-1] in _OBS_FACTORIES:
+                tail = "tracer"
+        if tail and _OBS_RECEIVER_RE.search(tail):
+            yield _diag(
+                mod, node, "obs-in-traced",
+                f"{tail}.{node.func.attr}() inside traced code counts "
+                f"per trace/compile, not per execution; hoist to the "
+                f"host loop (or suppress with the per-trace rationale)",
+            )
+
+
+# --------------------------------------------------------------------------
+# mutable-capture-in-dispatch
+# --------------------------------------------------------------------------
+
+_MUTATORS = frozenset({
+    "append", "extend", "insert", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "add", "clear", "sort", "reverse",
+})
+
+
+@rule(
+    "mutable-capture-in-dispatch", ERROR,
+    "Python-side mutation / mutable capture in a Dispatch transition",
+)
+def mutable_capture_in_dispatch(
+    mod: ModuleInfo, project: Project
+) -> Iterator[Diagnostic]:
+    """`Dispatch` transition and window functions are PURE by contract
+    (`ops/encoding.py`): `(state, args) -> (state, resp)` with no
+    Python-side effects. Mutating a captured object (a closure dict, a
+    module global, a mutable default) or the state argument itself
+    executes once at trace time and then never again — replicas
+    silently diverge from the replayed log. Build new pytrees; keep
+    every Python object you mutate local to the call."""
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            continue
+        if not project.is_dispatch_fn(fn):
+            continue
+        name = getattr(fn, "name", "<lambda>")
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = {
+                a.arg
+                for a in (
+                    list(fn.args.posonlyargs) + list(fn.args.args)
+                    + list(fn.args.kwonlyargs)
+                )
+            }
+            for default in (
+                list(fn.args.defaults) + list(fn.args.kw_defaults)
+            ):
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    yield _diag(
+                        mod, default, "mutable-capture-in-dispatch",
+                        f"{name}: mutable default argument is shared "
+                        f"across every call of a pure transition",
+                    )
+        else:
+            params = {a.arg for a in fn.args.args}
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        # Names REBOUND in the body (plain Name-store targets: fresh
+        # locals, loop vars, and `state = dict(state)`-style parameter
+        # rebinds to a fresh copy — the pure idiom must not be
+        # flagged). Subscript/attribute stores do not rebind and are
+        # exactly what the checks below look for.
+        assigned: set[str] = set()
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Name) and isinstance(
+                    n.ctx, ast.Store
+                ):
+                    assigned.add(n.id)
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    yield _diag(
+                        mod, node, "mutable-capture-in-dispatch",
+                        f"{name}: {'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                        f" rebinds state outside the pure transition",
+                    )
+                elif isinstance(node, (ast.Subscript, ast.Attribute)) \
+                        and isinstance(node.ctx, ast.Store):
+                    base = _base_name(node.value)
+                    if base is None or base in assigned:
+                        continue
+                    what = (
+                        "its state argument" if base in params
+                        else f"captured/global '{base}'"
+                    )
+                    yield _diag(
+                        mod, node, "mutable-capture-in-dispatch",
+                        f"{name}: mutates {what} in place; transitions "
+                        f"must return new pytrees (trace-time-only "
+                        f"effect => replica divergence)",
+                    )
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATORS
+                    and not _through_at(node.func.value)
+                ):
+                    base = _base_name(node.func.value)
+                    if base is None or base in assigned:
+                        continue
+                    target = (
+                        "its state argument" if base in params
+                        else f"captured/global '{base}'"
+                    )
+                    yield _diag(
+                        mod, node, "mutable-capture-in-dispatch",
+                        f"{name}: .{node.func.attr}() mutates "
+                        f"{target}; pure transitions must not "
+                        f"mutate non-local objects",
+                    )
+
+
+# --------------------------------------------------------------------------
+# wall-clock-time
+# --------------------------------------------------------------------------
+
+
+@rule(
+    "wall-clock-time", WARNING,
+    "time.time() where a monotonic clock is required",
+)
+def wall_clock_time(mod: ModuleInfo,
+                    project: Project) -> Iterator[Diagnostic]:
+    """Recorder/watchdog paths order and difference timestamps; wall
+    clocks step (NTP, suspend) and make durations negative and stall
+    detection lie. Use `time.monotonic()` for ordering and
+    `time.perf_counter()` for durations (`obs/recorder.py` module
+    docstring). The one legitimate wall-clock use — a correlation
+    field next to a monotonic stamp — carries a justified
+    suppression."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and (
+            mod.dotted(node.func) == "time.time"
+        ):
+            yield _diag(
+                mod, node, "wall-clock-time",
+                "time.time() steps with the wall clock; use "
+                "time.monotonic()/time.perf_counter() for ordering "
+                "and durations (wall-clock correlation fields need a "
+                "justified suppression)",
+            )
+
+
+# --------------------------------------------------------------------------
+# ring-index-unmasked
+# --------------------------------------------------------------------------
+
+_CURSOR_TOKENS = ("tail", "head", "ltail", "ctail", "pos", "start")
+_RING_BASES = ("log", "ml")
+_RING_ATTRS = ("opcodes", "args")
+
+
+def _mentions_cursor(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        ident = None
+        if isinstance(n, ast.Name):
+            ident = n.id
+        elif isinstance(n, ast.Attribute):
+            ident = n.attr
+        if ident and any(tok in ident for tok in _CURSOR_TOKENS):
+            return True
+    return False
+
+
+def _is_masked(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.BinOp) and isinstance(
+            n.op, (ast.BitAnd, ast.Mod)
+        ):
+            return True
+    return False
+
+
+def _local_aliases(mod: ModuleInfo, node: ast.AST) -> dict[str, ast.AST]:
+    """name -> value expr for simple single-target assignments in the
+    innermost enclosing function (one-level dataflow for index vars)."""
+    for fn in mod.enclosing_functions(node):
+        out: dict[str, ast.AST] = {}
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if (
+                    isinstance(n, ast.Assign)
+                    and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                ):
+                    out[n.targets[0].id] = n.value
+        return out
+    return {}
+
+
+@rule(
+    "ring-index-unmasked", WARNING,
+    "ring-buffer subscript from cursor math without & mask / % capacity",
+)
+def ring_index_unmasked(mod: ModuleInfo,
+                        project: Project) -> Iterator[Diagnostic]:
+    """Logical log positions are monotone int64 cursors; the physical
+    slot is ALWAYS `pos & (L-1)` (`core/log.py` module docstring,
+    `nr/src/log.rs:194-196`). Indexing `log.opcodes`/`log.args` (or a
+    `*_ring` array) with unmasked cursor math reads the wrong slot as
+    soon as the ring wraps — a bug no test with a small op count can
+    see. `jnp.where`/`lax.cond` selection on cursor validity does not
+    substitute for masking the slot index itself."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Subscript):
+            continue
+        target = node.value
+        if isinstance(target, ast.Attribute) and target.attr == "at":
+            target = target.value  # x.at[idx] scatter/gather form
+        is_ring = False
+        if isinstance(target, ast.Attribute) and (
+            target.attr in _RING_ATTRS
+        ):
+            base = _base_name(target.value)
+            if base in _RING_BASES or (
+                base is not None and base.endswith("_ring")
+            ):
+                is_ring = True
+        elif isinstance(target, ast.Name) and (
+            target.id.endswith("_ring")
+        ):
+            is_ring = True
+        if not is_ring:
+            continue
+        idx = node.slice
+        aliases = _local_aliases(mod, node)
+        exprs: list[ast.AST] = [idx]
+        for n in ast.walk(idx):
+            if isinstance(n, ast.Name) and n.id in aliases:
+                exprs.append(aliases[n.id])
+        if any(_mentions_cursor(e) for e in exprs) and not any(
+            _is_masked(e) for e in exprs
+        ):
+            yield _diag(
+                mod, node, "ring-index-unmasked",
+                "ring subscript derived from cursor math without "
+                "`& mask` / `% capacity`: wrong slot after the ring "
+                "wraps (mask the physical index, cf. core/log.py)",
+            )
+
+
+# --------------------------------------------------------------------------
+# lock-discipline
+# --------------------------------------------------------------------------
+
+
+def _is_locked_method(method: ast.AST) -> bool:
+    """Decorated with `@_locked` (or any `*locked*` wrapper): the whole
+    method body is one `with self._lock` region (`core/replica._locked`)."""
+    for dec in getattr(method, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = None
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Attribute):
+            name = target.attr
+        if name and "locked" in name:
+            return True
+    return False
+
+
+def _lock_withs(method: ast.AST, lock_attrs: set[str]) -> list[ast.With]:
+    out = []
+    for node in ast.walk(method):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ce = item.context_expr
+                if (
+                    isinstance(ce, ast.Attribute)
+                    and ce.attr in lock_attrs
+                    and isinstance(ce.value, ast.Name)
+                    and ce.value.id == "self"
+                ):
+                    out.append(node)
+    return out
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _is_effective_store(mod: ModuleInfo, node: ast.Attribute) -> bool:
+    if isinstance(node.ctx, ast.Store):
+        return True
+    parent = mod.parent(node)
+    # self.x[i] = v  /  self.x[i] += v: the Subscript is the store
+    # target, the Attribute itself is a Load
+    return (
+        isinstance(parent, ast.Subscript)
+        and parent.value is node
+        and isinstance(parent.ctx, ast.Store)
+    )
+
+
+@rule(
+    "lock-discipline", ERROR,
+    "guarded shared attribute accessed outside the instance lock",
+)
+def lock_discipline(mod: ModuleInfo,
+                    project: Project) -> Iterator[Diagnostic]:
+    """Lockset inference over `with self._lock` regions: any `self.X`
+    WRITTEN under a class's lock somewhere is a guarded attribute; a
+    write to it outside the lock (in any method but `__init__`), or a
+    read outside the lock in a method that also takes the lock
+    (check-then-act race), is a combiner-discipline violation. This is
+    the threaded combiner/reader contract of `core/replica.py` and
+    `core/cnr.py` (one combiner at a time — the flat-combining lock),
+    and the same pass covers `obs/`. Intentional lock-free fast paths
+    (e.g. a racy-but-benign enabled check) carry justified
+    suppressions."""
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        lock_attrs: set[str] = set()
+        for m in methods:
+            for node in ast.walk(m):
+                attr = _self_attr(node)
+                if (
+                    attr
+                    and attr.endswith("_lock")
+                    and isinstance(node.ctx, ast.Store)
+                ):
+                    lock_attrs.add(attr)
+        if not lock_attrs:
+            continue
+        guarded: set[str] = set()
+        for m in methods:
+            regions = (
+                [m] if _is_locked_method(m)
+                else _lock_withs(m, lock_attrs)
+            )
+            for region in regions:
+                for node in ast.walk(region):
+                    attr = _self_attr(node)
+                    if attr and attr not in lock_attrs and (
+                        _is_effective_store(mod, node)
+                    ):
+                        guarded.add(attr)
+        if not guarded:
+            continue
+        for m in methods:
+            if m.name == "__init__" or _is_locked_method(m):
+                continue
+            regions = _lock_withs(m, lock_attrs)
+            region_ids = {id(r) for r in regions}
+            for node in ast.walk(m):
+                attr = _self_attr(node)
+                if attr not in guarded:
+                    continue
+                inside = False
+                cur = mod.parent(node)
+                while cur is not None and cur is not m:
+                    if id(cur) in region_ids:
+                        inside = True
+                        break
+                    cur = mod.parent(cur)
+                if inside:
+                    continue
+                if _is_effective_store(mod, node):
+                    yield _diag(
+                        mod, node, "lock-discipline",
+                        f"{cls.name}.{m.name}: self.{attr} is written "
+                        f"under the lock elsewhere but written here "
+                        f"without it",
+                    )
+                elif regions:
+                    yield _diag(
+                        mod, node, "lock-discipline",
+                        f"{cls.name}.{m.name}: self.{attr} read "
+                        f"outside the lock in a method that takes it "
+                        f"(check-then-act race)",
+                    )
+
+
+# --------------------------------------------------------------------------
+# time-in-traced
+# --------------------------------------------------------------------------
+
+
+@rule(
+    "time-in-traced", ERROR,
+    "clock read inside traced code (executes once, at trace time)",
+)
+def time_in_traced(mod: ModuleInfo,
+                   project: Project) -> Iterator[Diagnostic]:
+    """A `time.*()` read inside traced code runs exactly once — while
+    tracing — and its value is frozen into the compiled program; every
+    subsequent step reuses the stale stamp. Timing belongs in the host
+    loop, around (and fencing) the device call (`obs/recorder.py`
+    spans)."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = mod.dotted(node.func)
+        if not d or not d.startswith("time."):
+            continue
+        if project.traced_context(mod, node) is None:
+            continue
+        if mod.in_eager_guard(node):
+            continue
+        yield _diag(
+            mod, node, "time-in-traced",
+            f"{d}() inside traced code is evaluated once at trace "
+            f"time and frozen into the program; time on the host side "
+            f"of the dispatch",
+        )

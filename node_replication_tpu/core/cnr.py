@@ -17,6 +17,7 @@ is the per-op convenience with the same replay kernels underneath.
 from __future__ import annotations
 
 import logging
+import threading
 from collections import deque
 from functools import partial
 from typing import Any, Callable
@@ -35,6 +36,7 @@ from node_replication_tpu.core.multilog import (
 from node_replication_tpu.core.replica import (
     MAX_THREADS_PER_REPLICA,
     ReplicaToken,
+    _locked,
     replicate_state,
     states_equal,
 )
@@ -74,6 +76,10 @@ class MultiLogReplicated:
         self.ml = multilog_init(self.spec)
         self.states = replicate_state(dispatch.init_state(), n_replicas)
 
+        # Combiner lock (`replica._locked`): one combiner pass at a
+        # time across all logs; reentrant so watchdog gc_callbacks can
+        # re-enter sync_log on the same thread.
+        self._lock = threading.RLock()
         self._threads_per_replica = [0] * n_replicas
         # staged ops: (rid, tid) -> deque[(log, opcode, args)]
         self._pending: dict[tuple[int, int], deque] = {}
@@ -153,6 +159,7 @@ class MultiLogReplicated:
     def nlogs(self) -> int:
         return self.spec.nlogs
 
+    @_locked
     def register(self, rid: int = 0) -> ReplicaToken:
         """Register a logical thread on replica `rid` — registration spans
         every log, as `cnr`'s replica registers with each
@@ -167,11 +174,13 @@ class MultiLogReplicated:
         self._resps[(rid, tid)] = deque()
         return ReplicaToken(rid, tid)
 
+    @_locked
     def _map(self, op: tuple) -> int:
         h = self.log_mapper(op[0], tuple(op[1:])) % self.nlogs
         self._log_selected[h] += 1
         return h
 
+    @_locked
     def execute_mut(self, op: tuple, token: ReplicaToken):
         """Route the write to its log, combine that log, return its
         response (`cnr/src/replica.rs:430-445`)."""
@@ -183,6 +192,7 @@ class MultiLogReplicated:
         q = self._resps[(token.rid, token.tid)]
         return q.pop() if q else None
 
+    @_locked
     def enqueue_mut(self, op: tuple, token: ReplicaToken) -> int:
         """Stage a write without combining (explicit batch building, the
         NodeReplicated twin). Its response arrives via `responses()`
@@ -194,6 +204,7 @@ class MultiLogReplicated:
         )
         return h
 
+    @_locked
     def flush(self, rid: int | None = None) -> None:
         """Combine every log with staged ops (all replicas by default)."""
         for r in range(self.n_replicas) if rid is None else [rid]:
@@ -205,6 +216,7 @@ class MultiLogReplicated:
             for h in sorted(logs):
                 self.combine(r, h)
 
+    @_locked
     def responses(self, token: ReplicaToken) -> list:
         """Drain delivered responses for this thread (enqueue order per
         log; delivery order across logs follows combine order)."""
@@ -213,6 +225,7 @@ class MultiLogReplicated:
         q.clear()
         return out
 
+    @_locked
     def execute(self, op: tuple, token: ReplicaToken):
         """Read path: sync only the mapped log, then dispatch locally
         (`cnr/src/replica.rs:599-617`)."""
@@ -232,6 +245,7 @@ class MultiLogReplicated:
             )
         )
 
+    @_locked
     def combine(self, rid: int, log_idx: int) -> None:
         """Drain replica `rid`'s staged ops for `log_idx` (thread order),
         append them to that log, and replay it until `rid` has applied its
@@ -283,11 +297,13 @@ class MultiLogReplicated:
                 rounds = self._watchdog(rounds, log_idx, "combine-replay")
             sp.fence(self.ml, self.states)
 
+    @_locked
     def sync(self, rid: int | None = None) -> None:
         """Catch up on every log (`cnr/src/replica.rs:579-597`)."""
         for l in range(self.nlogs):
             self.sync_log(rid, l)
 
+    @_locked
     def sync_log(self, rid: int | None, log_idx: int) -> None:
         """Targeted single-log sync (`sync_log`,
         `cnr/src/replica.rs:579-597`). The harness wires the GC callback
@@ -307,14 +323,17 @@ class MultiLogReplicated:
             self._exec_round(log_idx)
             rounds = self._watchdog(rounds, log_idx, "sync")
 
+    @_locked
     def verify(self, fn: Callable[[Any], Any], rid: int = 0):
         self.sync()
         state = jax.tree.map(lambda a: np.asarray(a[rid]), self.states)
         return fn(state)
 
+    @_locked
     def replicas_equal(self) -> bool:
         return states_equal(self.states)
 
+    @_locked
     def stats(self) -> dict:
         """Flat per-log counters (original three keys stable);
         `snapshot()` is the structured superset."""
@@ -328,6 +347,7 @@ class MultiLogReplicated:
             "idle_rounds": self._idle_rounds,
         }
 
+    @_locked
     def snapshot(self) -> dict:
         """Structured observability snapshot (JSON-safe), the CNR twin of
         `NodeReplicated.snapshot()`: per-log cursors and per-(log,
@@ -376,6 +396,7 @@ class MultiLogReplicated:
 
     # ------------------------------------------------------------ internals
 
+    @_locked
     def _exec_round(self, log_idx: int) -> None:
         # one fused cursor readback per round (see the
         # NodeReplicated._exec_round note on tunnel D2H RTTs)

@@ -286,6 +286,7 @@ def log_catchup_all(
     limits: jax.Array | None = None,
     need_resps: bool = True,
     on_trajectory: bool = True,
+    union: bool | None = None,
 ):
     """Combined catch-up: `log_exec_all` semantics at combined speed.
 
@@ -295,6 +296,15 @@ def log_catchup_all(
     the per-replica `window_apply` tier, which is correct for arbitrary
     state. Every log-driven fleet (NodeReplicated, the runners, recovery,
     grow_fleet) is on-trajectory by construction.
+
+    `union` selects the union-plan tier: None (default) takes it only
+    for models that declare `Dispatch.window_canonical=True` — the
+    explicit opt-in to the prefix-absorbing/canonical-responses
+    contract (ADVICE r5: presence of window_plan alone only claims the
+    lock-step contract and must not route a third-party model through
+    the stronger-contract engine). True FORCES the tier (the
+    `engine='combined'` caller asserting the contract); False never
+    takes it.
 
     `need_resps=False` (pure recovery: checkpoint replay, crash
     rebuild, the catch-up bench) skips the per-replica response
@@ -340,14 +350,20 @@ def log_catchup_all(
     `tests/test_window.py::TestCombinedCatchup`.
     """
     if d.window_apply is None and d.window_plan is None:
+        # nrlint: disable=obs-in-traced — per-trace tier counter by design
         _m_engine_scan.inc()
         return log_exec_all(spec, d, log, states, window, limits)
-    if d.window_plan is not None and limits is None and on_trajectory:
+    take_union = (
+        d.window_canonical if union is None else union
+    ) and d.window_plan is not None
+    if take_union and limits is None and on_trajectory:
         return _catchup_union_plan(spec, d, log, states, window,
                                    need_resps)
     if d.window_apply is None:
+        # nrlint: disable=obs-in-traced — per-trace tier counter by design
         _m_engine_scan.inc()
         return log_exec_all(spec, d, log, states, window, limits)
+    # nrlint: disable=obs-in-traced — per-trace tier counter by design
     _m_engine_window.inc()
 
     def one(state, ltail, limit=None):
@@ -424,6 +440,7 @@ def _catchup_union_plan(
             _m_idle_skips.inc()
             R = log.ltails.shape[0]
             return log, states, jnp.zeros((R, window), jnp.int32)
+    # nrlint: disable=obs-in-traced — per-trace tier counter by design
     _m_engine_union.inc()
     m = jnp.min(log.ltails)
     end = jnp.minimum(m + window, log.tail)

@@ -244,6 +244,7 @@ def multilog_exec_all(
         stacked = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), stacked)
 
         if combined and lockstep and window > 0:
+            # nrlint: disable=obs-in-traced — per-trace tier counter
             _m_ml_lockstep.inc()
 
             # lock-step: gather each log's window once (ltails[0] speaks
@@ -271,6 +272,7 @@ def multilog_exec_all(
                     jnp.broadcast_to(new_lt, ltails.shape),
                 )
         else:
+            # nrlint: disable=obs-in-traced — per-trace tier counter
             (_m_ml_combined if combined else _m_ml_part_scan).inc()
 
             def per_log(opc, arg, tail, sub_states, ltails):
@@ -287,6 +289,7 @@ def multilog_exec_all(
         new_subs = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), new_subs)
         states = jax.vmap(partitioned.merge)(new_subs)
     else:
+        # nrlint: disable=obs-in-traced — per-trace tier counter
         _m_ml_seq.inc()
         resps_list = []
         ltails_list = []

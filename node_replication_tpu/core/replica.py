@@ -34,7 +34,9 @@ path is `core/step.py`.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
+import threading
 import time
 from collections import deque
 from functools import partial
@@ -84,6 +86,30 @@ class ReplicaToken(NamedTuple):
 
 class LogTooSmallError(RuntimeError):
     """A single batch exceeds the log's appendable capacity."""
+
+
+def _locked(fn):
+    """Run a method under the instance's combiner lock (`self._lock`).
+
+    The reference elects a combiner with a CAS lock
+    (`nr/src/replica.rs:508-540`); threads that lose the race spin or
+    enqueue. Here the wrappers' shared mutable host state (`log`,
+    `states`, contexts, in-flight queues, counters) is guarded by one
+    reentrant combiner lock: each public entry point is one critical
+    section, so concurrent logical threads can call `execute_mut` /
+    `execute` / `combine` from real OS threads and observe consistent
+    cursors. Reentrant because combine -> _exec_round -> gc_callback ->
+    sync_log chains re-enter on the same thread. The nrlint
+    `lock-discipline` rule understands this decorator as a whole-method
+    `with self._lock` region.
+    """
+
+    @functools.wraps(fn)
+    def inner(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return inner
 
 
 def replicate_state(state, n_replicas: int):
@@ -155,6 +181,9 @@ class NodeReplicated:
         self.log = log_init(self.spec)
         self.states = replicate_state(dispatch.init_state(), n_replicas)
 
+        # Combiner lock (see `_locked`): guards log/states/cursor and
+        # context bookkeeping against concurrent OS-thread callers.
+        self._lock = threading.RLock()
         self._contexts: dict[tuple[int, int], Context] = {}
         self._threads_per_replica = [0] * n_replicas
         # Appended-but-unanswered ops per replica: deque[(logical_pos, tid)].
@@ -196,19 +225,35 @@ class NodeReplicated:
                 f"{dispatch.name}: window_plan and window_merge come "
                 f"as a pair (got only one)"
             )
-        has_combined = (
+        has_any_combined = (
             dispatch.window_apply is not None
             or dispatch.window_plan is not None
         )
-        if engine == "combined" and not has_combined:
+        if engine == "combined" and not has_any_combined:
             raise ValueError(
                 f"engine='combined' but {dispatch.name} has no "
                 f"window_apply or window_plan"
             )
+        # 'auto' resolves to the combined engine only when a combined
+        # tier will actually run: window_apply, or a plan/merge pair
+        # that opted into the union contract (window_canonical). A
+        # lock-step-only plan/merge model would otherwise fall through
+        # to the scan inside log_catchup_all every round while
+        # stats()/metrics reported 'combined'.
+        auto_combined = (
+            dispatch.window_apply is not None
+            or (dispatch.window_plan is not None
+                and dispatch.window_canonical)
+        )
         use_combined = (
-            has_combined if engine == "auto" else engine == "combined"
+            auto_combined if engine == "auto" else engine == "combined"
         )
         self.engine = "combined" if use_combined else "scan"
+        # engine='combined' is the caller EXPLICITLY asserting the
+        # union-tier contract; 'auto' defers to the model's own
+        # `window_canonical` opt-in (ADVICE r5: presence of a
+        # plan/merge pair only claims the lock-step contract)
+        self._union = True if engine == "combined" else None
         # per-round engine usage (host truth for the wrapper; core/log.py
         # counts per-trace selections of the inner tiers)
         self._m_engine = reg.counter(f"nr.exec.engine.{self.engine}")
@@ -220,7 +265,8 @@ class NodeReplicated:
         (growing changes `n_replicas`, so the partials must rebind)."""
         dispatch = self.dispatch
         exec_fn = (
-            log_catchup_all if self.engine == "combined" else log_exec_all
+            partial(log_catchup_all, union=self._union)
+            if self.engine == "combined" else log_exec_all
         )
         if self.debug:
             from node_replication_tpu.utils.checks import checked
@@ -254,6 +300,7 @@ class NodeReplicated:
     def n_replicas(self) -> int:
         return self.spec.n_replicas
 
+    @_locked
     def register(self, rid: int = 0) -> ReplicaToken:
         """Register a logical thread on replica `rid`
         (`Replica::register`, `nr/src/replica.rs:279-298`)."""
@@ -268,6 +315,7 @@ class NodeReplicated:
         self._contexts[(rid, tid)] = Context()
         return ReplicaToken(rid, tid)
 
+    @_locked
     def grow_fleet(self, k: int = 1, donor: int | None = None,
                    catch_up: bool = True) -> list[int]:
         """Dynamic replica registration: add `k` replicas to a LIVE
@@ -330,6 +378,7 @@ class NodeReplicated:
                 self.sync(rid)
         return new_rids
 
+    @_locked
     def execute_mut(self, op: tuple, token: ReplicaToken):
         """Stage one write op, combine, and return its response
         (`Replica::execute_mut`, `nr/src/replica.rs:345-356`)."""
@@ -343,6 +392,7 @@ class NodeReplicated:
         # responses stay queued, in order, for `responses()`.
         return ctx.res_newest()
 
+    @_locked
     def enqueue_mut(self, op: tuple, token: ReplicaToken) -> None:
         """Stage a write without combining (explicit flat-combining batch
         building). Combines first if this thread's 32-slot ring is full."""
@@ -351,11 +401,13 @@ class NodeReplicated:
             self.combine(token.rid)
             ctx.enqueue(op[0], tuple(op[1:]))
 
+    @_locked
     def flush(self, rid: int | None = None) -> None:
         """Combine pending batches (all replicas by default)."""
         for r in range(self.n_replicas) if rid is None else [rid]:
             self.combine(r)
 
+    @_locked
     def responses(self, token: ReplicaToken) -> list:
         """Drain delivered responses for this thread, in enqueue order."""
         ctx = self._contexts[(token.rid, token.tid)]
@@ -366,6 +418,7 @@ class NodeReplicated:
             r = ctx.res()
         return out
 
+    @_locked
     def execute(self, op: tuple, token: ReplicaToken):
         """Read path (`Replica::execute` → `read_only`,
         `nr/src/replica.rs:404-410`, `483-497`): wait until this replica has
@@ -388,6 +441,7 @@ class NodeReplicated:
             )
         )
 
+    @_locked
     def combine(self, rid: int) -> None:
         """Drain this replica's thread contexts (thread order —
         `nr/src/replica.rs:555-557`), append the batch, and replay until
@@ -434,6 +488,7 @@ class NodeReplicated:
                 rounds = self._watchdog(rounds, "combine-replay")
             sp.fence(self.log, self.states)
 
+    @_locked
     def sync(self, rid: int | None = None) -> None:
         """Catch replicas up with the log tail (`Replica::sync`,
         `nr/src/replica.rs:469-479`); `rid=None` syncs all."""
@@ -451,6 +506,7 @@ class NodeReplicated:
             self._exec_round()
             rounds = self._watchdog(rounds, "sync")
 
+    @_locked
     def checkpoint(self, path: str) -> None:
         """Durable snapshot of log + all replica states (see
         `core/checkpoint.py`; the recovery model is deterministic-init +
@@ -477,6 +533,7 @@ class NodeReplicated:
         _, nr.log, nr.states = load_snapshot(path, nr.states)
         return nr
 
+    @_locked
     def recover(self, base_states=None, base_pos: int | None = None) -> None:
         """Discard replica states and rebuild them by replay
         (deterministic-init + replay — the reference's recovery model,
@@ -494,6 +551,7 @@ class NodeReplicated:
         )
         self._inflight = [deque() for _ in range(self.n_replicas)]
 
+    @_locked
     def stats(self) -> dict:
         """Flat observability counters (the harness's per-second ops
         capture is the reference's profiling story,
@@ -512,6 +570,7 @@ class NodeReplicated:
             "max_lag": tail - int(ltails.min()),
         }
 
+    @_locked
     def snapshot(self) -> dict:
         """Structured observability snapshot (JSON-safe): log cursors and
         ring occupancy, per-replica lag (`tail - ltails[r]`), exec-round
@@ -550,6 +609,7 @@ class NodeReplicated:
             "metrics": get_registry().snapshot(),
         }
 
+    @_locked
     def verify(self, fn: Callable[[Any], Any], rid: int = 0):
         """Test hook (`Replica::verify`, `nr/src/replica.rs:443-467`):
         force-sync, then expose replica `rid`'s state (as host numpy pytree)
@@ -558,6 +618,7 @@ class NodeReplicated:
         state = jax.tree.map(lambda a: np.asarray(a[rid]), self.states)
         return fn(state)
 
+    @_locked
     def replicas_equal(self) -> bool:
         """All replicas converged to identical state."""
         return states_equal(self.states)
@@ -574,6 +635,7 @@ class NodeReplicated:
             return log
         return self._append_jit(self.log, opcodes, args, n)
 
+    @_locked
     def _exec_round(self) -> bool:
         """One static-window replay round for every replica, plus response
         distribution. Returns True if any replica made progress.

@@ -133,6 +133,10 @@ def make_step(
                     ok = ok & jnp.all(leaf == leaf[:1])
                 msg = ("plan/merge fast path requires a lock-step fleet "
                        "(synced cursors + identical replica states)")
+            # deliberately ALWAYS armed: this guard is locally
+            # checkify.checkify-wrapped below, independent of the
+            # debug_checks() arming contract
+            # nrlint: disable=raw-checkify-check
             checkify.check(
                 ok,
                 msg + "; use combined=False or NodeReplicated catch-up "

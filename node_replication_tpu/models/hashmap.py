@@ -160,4 +160,7 @@ def make_hashmap(n_keys: int, prefill_value: int | None = None) -> Dispatch:
         window_apply=window_apply,
         window_plan=window_plan,
         window_merge=window_merge,
+        # prefix-absorbing plan + canonical responses pinned by
+        # tests/test_window.py::test_plan_is_prefix_absorbing
+        window_canonical=True,
     )

@@ -325,4 +325,5 @@ def make_memfs(n_files: int, n_blocks: int) -> Dispatch:
         window_apply=window_apply,
         window_plan=window_plan,
         window_merge=window_merge,
+        window_canonical=True,
     )

@@ -155,4 +155,5 @@ def make_queue(capacity: int) -> Dispatch:
         window_apply=window_apply,
         window_plan=window_plan,
         window_merge=window_merge,
+        window_canonical=True,
     )

@@ -133,4 +133,5 @@ def make_sortedset(n_keys: int) -> Dispatch:
         window_apply=window_apply,
         window_plan=window_plan,
         window_merge=window_merge,
+        window_canonical=True,
     )

@@ -144,4 +144,5 @@ def make_stack(capacity: int) -> Dispatch:
         window_apply=window_apply,
         window_plan=window_plan,
         window_merge=window_merge,
+        window_canonical=True,
     )

@@ -207,6 +207,7 @@ def make_vspace(n_pages: int, max_span: int = 16) -> Dispatch:
         window_apply=window_apply if ok_combined else None,
         window_plan=window_plan if ok_combined else None,
         window_merge=window_merge if ok_combined else None,
+        window_canonical=ok_combined,
     )
 
 
@@ -692,4 +693,5 @@ def make_vspace_radix(n_pages: int, max_span: int = 16) -> Dispatch:
         window_apply=window_apply,
         window_plan=window_plan,
         window_merge=window_merge,
+        window_canonical=True,
     )

@@ -1009,6 +1009,13 @@ uint64_t nr_bench_log_append(uint64_t log_capacity, int n_threads, int batch,
           uint64_t t = lg.tail.load(std::memory_order_relaxed);
           uint64_t h = lg.ltails[0].v.load(std::memory_order_relaxed);
           if (t + batch > h + lg.capacity) {
+            // Ring full: space only appears when the chaser advances
+            // ltails, and the chaser exits as soon as `stop` is set —
+            // without this check an appender caught here at stop time
+            // spins forever and join() hangs (observed as a rare
+            // full-suite livelock under CPU load; the inner loop
+            // otherwise never reads `stop`).
+            if (stop.load(std::memory_order_relaxed)) break;
             cpu_relax();
             continue;
           }

@@ -60,10 +60,14 @@ class Dispatch:
     fused step's lock-step precondition (all replicas identical by
     induction).
 
-    STRENGTHENED CONTRACT for divergent cursors: providing this pair
-    also opts the model into the union-window catch-up tier —
-    `NodeReplicated(engine='auto')` and `log_catchup_all` route ANY
-    plan/merge model through `core/log.py:_catchup_union_plan`, which
+    STRENGTHENED CONTRACT for divergent cursors (`window_canonical`):
+    the union-window catch-up tier is an explicit OPT-IN, not implied
+    by the pair's presence. A model sets `window_canonical=True` to
+    declare its plan/merge satisfies the stronger contract below;
+    only then do `NodeReplicated(engine='auto')` and `log_catchup_all`
+    route it through `core/log.py:_catchup_union_plan` (and
+    `engine='combined'` still FORCES that tier explicitly, canonical
+    flag or not — the caller is asserting the contract). The tier
     merges the plan of the union window `[min(ltails), end)` (computed
     from the most-lagging replica's state) into replicas that already
     applied an arbitrary PREFIX of that window. Beyond the lock-step
@@ -81,12 +85,14 @@ class Dispatch:
       merging replica's pre-merge state, because catch-up re-indexes the
       donor plan's responses for every replica's own offsets.
 
-    A model whose plan/merge satisfies only the lock-step contract must
-    NOT provide the pair as-is: run it through `NodeReplicated(...,
-    engine='scan')`, or call `log_catchup_all(...,
-    on_trajectory=False)` for hand-built fleets, or supply only
-    `window_apply`. Differential coverage:
-    `tests/test_window.py::TestCombinedCatchup`.
+    A model whose plan/merge satisfies only the lock-step contract
+    simply leaves `window_canonical=False` (the default): it keeps the
+    fused lock-step fast path, and catch-up falls back to the
+    per-replica `window_apply` tier or the scan — third-party models
+    are never silently routed through the stronger-contract engine
+    (ADVICE r5). Hand-built off-trajectory fleets additionally pass
+    `log_catchup_all(..., on_trajectory=False)`. Differential
+    coverage: `tests/test_window.py::TestCombinedCatchup`.
 
     `window_apply` (optional) is the *combined replay* fast path:
     `(state, opcodes[W], args[W, A]) -> (state, resps[W])`, bit-identical
@@ -110,6 +116,11 @@ class Dispatch:
     window_apply: Callable | None = None
     window_plan: Callable | None = None
     window_merge: Callable | None = None
+    # Explicit opt-in to the union-window catch-up tier: asserts the
+    # plan is prefix-absorbing and merge responses are canonical (see
+    # class docstring). Mere presence of window_plan/window_merge only
+    # claims the weaker lock-step contract.
+    window_canonical: bool = False
 
     @property
     def n_write_ops(self) -> int:

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from node_replication_tpu.core.log import LogSpec, log_append
+from node_replication_tpu.utils.compat import x64_disabled
 
 _OCC = 1
 _TOMB = 2
@@ -96,7 +97,7 @@ def _oa_kernel(opc_ref, a0_ref, a1_ref,
                *, n_slots: int, probe: int, window: int, rows: int,
                span_rows: int):
     # compile-time re-trace happens outside any caller's x64 guard
-    with jax.enable_x64(False):
+    with x64_disabled():
         _oa_body(opc_ref, a0_ref, a1_ref, k_in, v_in, f_in, k_out,
                  v_out, f_out, resp_ref, n_slots, probe, window, rows,
                  span_rows)
@@ -263,7 +264,7 @@ def make_oahashmap_replay(
     calls = build_calls(n_replicas, chunk_r, build_call)
 
     def replay(opc, args, keys, vals, flag):
-        with jax.enable_x64(False):
+        with x64_disabled():
             a0, a1 = args[:, 0], args[:, 1]
             (keys, vals, flag), (resps,) = run_chunks(
                 n_replicas, chunk_r, calls,

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from node_replication_tpu.core.log import LogSpec, log_append
+from node_replication_tpu.utils.compat import x64_disabled
 
 
 def _round_up(x: int, m: int) -> int:
@@ -161,7 +162,7 @@ def make_hashmap_replay(
         # for int64 log cursors, but x64-canonicalized index-map constants
         # (i64) send the Mosaic lowering into an unsupported-convert loop.
         # Every kernel operand is int32, so the narrowing context is inert.
-        with jax.enable_x64(False):
+        with x64_disabled():
             return call(opcodes, keys, vals, values, present)
 
     return replay

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from node_replication_tpu.core.log import LogSpec, log_append
+from node_replication_tpu.utils.compat import x64_disabled
 
 _FRAME_MASK = (1 << 30) - 1
 _DEV_BIT = 1 << 30
@@ -115,7 +116,7 @@ def _flat_kernel(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, fr_out, resp_ref,
     # the kernel is (re-)traced at jit-COMPILE time, outside any caller's
     # enable_x64(False) context — guard here so an x64 session can't
     # leak int64 converts into the Mosaic lowering
-    with jax.enable_x64(False):
+    with x64_disabled():
         _flat_body(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, fr_out,
                    resp_ref, n_pages, max_span, window, rows, span_rows,
                    copy_in=True)
@@ -130,7 +131,7 @@ def _flat_plan_kernel(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, tch_in,
     # model-side `window_merge` blends per replica (see
     # make_pallas_vspace_plan_step)
     del tch_in  # aliased to tch_out
-    with jax.enable_x64(False):
+    with x64_disabled():
         _flat_body(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, fr_out,
                    resp_ref, n_pages, max_span, window, rows, span_rows,
                    tch_out=tch_out)
@@ -218,7 +219,7 @@ def _radix_kernel(opc_ref, a0_ref, a1_ref, a2_ref,
                   *, n_pages: int, max_span: int, window: int, rows: int,
                   height: int, l2: int, l3: int, l4: int):
     # see _flat_kernel: guard the compile-time re-trace against x64
-    with jax.enable_x64(False):
+    with x64_disabled():
         _radix_body(opc_ref, a0_ref, a1_ref, a2_ref, pt_in, pd_in,
                     pdpt_in, pml4_in, pt_out, pd_out, pdpt_out, pml4_out,
                     resp_ref, n_pages, max_span, window, rows, height,
@@ -240,7 +241,7 @@ def _radix_plan_kernel(opc_ref, a0_ref, a1_ref, a2_ref,
     # ride the same lane masks as the state blends; the scalar stream is
     # unchanged except two SMEM flag stores per entry.
     del wins_in, clr_in, pdt_in  # aliased to their outs
-    with jax.enable_x64(False):
+    with x64_disabled():
         _radix_body(opc_ref, a0_ref, a1_ref, a2_ref, pt_in, pd_in,
                     pdpt_in, pml4_in, pt_out, pd_out, pdpt_out, pml4_out,
                     resp_ref, n_pages, max_span, window, rows, height,
@@ -469,7 +470,7 @@ def make_vspace_replay(
         calls = build_calls(n_replicas, chunk_r, build_call)
 
         def replay(opc, args, frames):
-            with jax.enable_x64(False):
+            with x64_disabled():
                 a0, a1, a2 = args[:, 0], args[:, 1], args[:, 2]
                 (frames,), (resps,) = run_chunks(
                     n_replicas, chunk_r, calls,
@@ -514,7 +515,7 @@ def make_vspace_replay(
     calls = build_calls(n_replicas, chunk_r, build_call)
 
     def replay(opc, args, pt, pd, pdpt, pml4):
-        with jax.enable_x64(False):
+        with x64_disabled():
             a0, a1, a2 = args[:, 0], args[:, 1], args[:, 2]
             pd3 = pd.reshape(1, 1, l2)
             pdpt3 = pdpt.reshape(1, 1, l3)
@@ -595,7 +596,7 @@ def make_vspace_plan_replay(
         )
 
         def plan_replay(opc, args, frames, tch):
-            with jax.enable_x64(False):
+            with x64_disabled():
                 frames, tch, resps = call(
                     opc, args[:, 0], args[:, 1], args[:, 2], frames, tch
                 )
@@ -631,7 +632,7 @@ def make_vspace_plan_replay(
     )
 
     def plan_replay(opc, args, pt, pd, pdpt, pml4, wins, clr, pdt):
-        with jax.enable_x64(False):
+        with x64_disabled():
             pt, pd, pdpt, pml4, resps, wins, clr, pdt = call(
                 opc, args[:, 0], args[:, 1], args[:, 2], pt,
                 pd.reshape(1, 1, l2), pdpt.reshape(1, 1, l3),

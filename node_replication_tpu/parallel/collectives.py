@@ -40,6 +40,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from node_replication_tpu.core.log import LogSpec, LogState, _exec_one
+from node_replication_tpu.utils.compat import shard_map
 from node_replication_tpu.ops.encoding import (
     Dispatch,
     NOOP,
@@ -107,7 +108,7 @@ def make_shmap_step(
         return log, states_l, wr_resps_l, rd_resps_l
 
     shardy = P(axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(
@@ -176,7 +177,7 @@ def make_ring_exec(
         return states
 
     shardy = P(axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(shardy, shardy,

@@ -20,16 +20,31 @@ Two flags with different blast radii:
   functionalized (as `NodeReplicated` does internally). With the flag
   off, `check()` is a no-op at trace time and the compiled program is
   bit-identical to the unchecked one (zero cost off).
+
+Usage of this module (instead of raw `checkify.check`, which bypasses
+the arming contract above) is machine-enforced by the nrlint rule
+`raw-checkify-check` (`node_replication_tpu/analysis/`, run as
+`python -m node_replication_tpu.analysis.lint node_replication_tpu/`).
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import os
 
 from jax.experimental import checkify
 
-_ctx_enabled = False
+# Context-local arming flag: `debug_checks()` must only arm `check()`
+# for code traced in THIS thread/task. A module-global here would let
+# one thread's debug context manager arm checks inside another
+# thread's concurrently-tracing un-functionalized jit — a trace-time
+# crash injected across threads. A ContextVar is inherited by the
+# arming thread's own nested traces (tracing runs synchronously in the
+# calling thread) and by nothing else.
+_ctx_enabled: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "nr_tpu_debug_checks", default=False
+)
 
 
 def debug_default() -> bool:
@@ -38,26 +53,26 @@ def debug_default() -> bool:
 
 
 def debug_checks_enabled() -> bool:
-    return _ctx_enabled
+    return _ctx_enabled.get()
 
 
 @contextlib.contextmanager
 def debug_checks(on: bool = True):
     """Arm `check()` for functions traced within (tracing happens at the
     first CALL of a jitted function, not at `jax.jit`). Only wrap calls
-    to `checked()`-functionalized functions."""
-    global _ctx_enabled
-    old, _ctx_enabled = _ctx_enabled, on
+    to `checked()`-functionalized functions. Thread-local: arming here
+    never affects traces running concurrently in other threads."""
+    token = _ctx_enabled.set(on)
     try:
         yield
     finally:
-        _ctx_enabled = old
+        _ctx_enabled.reset(token)
 
 
 def check(pred, msg: str, **fmt) -> None:
     """Emit a checkify invariant when armed at trace time; no-op (and no
     cost in the compiled program) otherwise."""
-    if _ctx_enabled:
+    if _ctx_enabled.get():
         checkify.check(pred, msg, **fmt)
 
 

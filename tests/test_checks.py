@@ -20,7 +20,12 @@ from node_replication_tpu.core.replica import NodeReplicated, replicate_state
 from node_replication_tpu.models import HM_GET, HM_PUT, make_hashmap, make_stack
 from node_replication_tpu.models.stack import ST_PUSH
 from node_replication_tpu.ops.encoding import encode_ops
-from node_replication_tpu.utils.checks import checked, debug_checks
+from node_replication_tpu.utils.checks import (
+    check,
+    checked,
+    debug_checks,
+    debug_checks_enabled,
+)
 
 
 def small():
@@ -100,6 +105,91 @@ class TestInvariantChecks:
         assert "check" not in str(jaxpr)
         log2, states2, _ = log_exec_all(spec, d, log, states, 2)
         assert int(log2.tail) == 0
+
+
+class TestThreadLocalArming:
+    """`debug_checks` arming is context-local (ISSUE 2 satellite): the
+    flag used to be a module global, so one thread's debug context
+    manager armed/disarmed checks for ALL threads — a concurrently
+    tracing un-functionalized jit in another thread would hit a live
+    `checkify.check` and crash at trace time."""
+
+    def test_arming_does_not_leak_across_threads(self):
+        import threading
+
+        barrier = threading.Barrier(2, timeout=30)
+        seen: dict[str, bool] = {}
+        errors: list[BaseException] = []
+
+        def armer():
+            try:
+                with debug_checks(True):
+                    barrier.wait()  # armed; let the observer sample
+                    barrier.wait()  # hold until the observer is done
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+                barrier.abort()
+
+        def observer():
+            try:
+                barrier.wait()
+                seen["peer_armed"] = debug_checks_enabled()
+                barrier.wait()
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+                barrier.abort()
+
+        ts = [threading.Thread(target=armer),
+              threading.Thread(target=observer)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not errors
+        assert seen == {"peer_armed": False}
+
+    def test_plain_jit_in_other_thread_traces_while_armed(self):
+        # the end-to-end regression: thread B traces a PLAIN
+        # (un-functionalized) jit containing check() while thread A
+        # holds debug_checks(True); with a process-global flag B's
+        # trace armed the check and raised at trace time
+        import threading
+
+        barrier = threading.Barrier(2, timeout=30)
+        out: dict[str, object] = {}
+        errors: list[BaseException] = []
+
+        def armer():
+            try:
+                with debug_checks(True):
+                    barrier.wait()  # armed before B traces
+                    barrier.wait()  # stay armed until B finishes
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+                barrier.abort()
+
+        def tracer_thread():
+            try:
+                barrier.wait()
+
+                def f(x):
+                    check(x >= 0, "negative {x}", x=x)
+                    return x + 1
+
+                out["res"] = int(jax.jit(f)(jnp.int32(3)))
+                barrier.wait()
+            except BaseException as e:
+                errors.append(e)
+                barrier.abort()
+
+        ts = [threading.Thread(target=armer),
+              threading.Thread(target=tracer_thread)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert out["res"] == 4
 
 
 class TestNodeReplicatedDebug:

@@ -344,3 +344,70 @@ class TestGrowFleet:
         # wider fleet appends 4*2 per step
         assert int(r.log.tail) == tail_before + 3 * 8
         assert (np.asarray(r.log.ltails) == int(r.log.tail)).all()
+
+
+class TestCombinerLock:
+    """The combiner lock (`core/replica._locked`, ISSUE 2): concurrent
+    OS threads driving one NodeReplicated must serialize through the
+    lock and leave consistent cursors/state — the coarse-grained analog
+    of the reference combiner CAS (`nr/src/replica.rs:508-540`).
+    Enforced statically by the nrlint `lock-discipline` rule; this is
+    the dynamic smoke test."""
+
+    def test_concurrent_writers_on_distinct_replicas(self):
+        import threading
+
+        R, PER = 2, 24
+        nr = small_nr(make_hashmap(64), n_replicas=R, log_entries=512)
+        tokens = [nr.register(r) for r in range(R)]
+        errors: list[BaseException] = []
+
+        def writer(rid: int):
+            try:
+                for i in range(PER):
+                    k = rid * PER + i
+                    nr.execute_mut((HM_PUT, k, k * 10), tokens[rid])
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+
+        ts = [threading.Thread(target=writer, args=(r,))
+              for r in range(R)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errors, errors
+        nr.sync()
+        assert int(nr.log.tail) == R * PER
+        assert nr.replicas_equal()
+        reader = nr.register(0)
+        for k in range(R * PER):
+            assert nr.execute((HM_GET, k), reader) == k * 10
+
+    def test_concurrent_readers_and_writer(self):
+        import threading
+
+        nr = small_nr(make_hashmap(32), n_replicas=2, log_entries=512)
+        wt = nr.register(0)
+        rt = nr.register(1)
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    v = nr.execute((HM_GET, 1), rt)
+                    assert v in (-1, 7)
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            for _ in range(10):
+                nr.execute_mut((HM_PUT, 1, 7), wt)
+        finally:
+            stop.set()
+            t.join(timeout=120)
+        assert not errors, errors
+        assert nr.execute((HM_GET, 1), nr.register(0)) == 7

@@ -692,6 +692,103 @@ class TestCombinedCatchup:
                 assert int(st2["values"][r, k]) == 100
         assert (np.asarray(log2.ltails) == N).all()
 
+    def test_union_tier_requires_canonical_opt_in(self):
+        # ADVICE r5 / ISSUE 2: presence of window_plan/window_merge only
+        # claims the lock-step contract; the union-window catch-up tier
+        # needs the EXPLICIT `window_canonical=True` opt-in (all bundled
+        # models set it) or an explicit union=True force from an
+        # engine='combined' caller. Tier routing is observed through the
+        # log.engine.* dispatch counters; results stay bit-equal either
+        # way (hashmap satisfies both contracts).
+        import dataclasses
+
+        from node_replication_tpu.core.log import (
+            log_append,
+            log_catchup_all,
+        )
+        from node_replication_tpu.obs.metrics import get_registry
+
+        K, R, N, W = 16, 2, 8, 8
+        d = make_hashmap(K)
+        assert d.window_canonical
+        d_weak = dataclasses.replace(d, window_canonical=False)
+        spec = LogSpec(capacity=64, n_replicas=R, arg_width=3,
+                       gc_slack=8)
+        opc = jnp.full((N,), HM_PUT, jnp.int32)
+        ag = jnp.zeros((N, 3), jnp.int32).at[:, 0].set(
+            jnp.arange(N, dtype=jnp.int32)
+        ).at[:, 1].set(7)
+
+        def fresh():
+            log = log_append(spec, log_init(spec), opc, ag, N)
+            return log, replicate_state(d.init_state(), R)
+
+        reg = get_registry()
+        was_enabled = reg.enabled
+        reg.enable()
+        c_union = reg.counter("log.engine.union_plan")
+        c_window = reg.counter("log.engine.window_apply")
+        try:
+            # canonical model: auto routing takes the union tier
+            log, states = fresh()
+            u0, w0 = c_union.value, c_window.value
+            _, st_canon, _ = log_catchup_all(spec, d, log, states, W)
+            assert c_union.value == u0 + 1
+
+            # weak model (lock-step-only contract): auto routing must
+            # NOT take the stronger-contract engine
+            log, states = fresh()
+            u0, w0 = c_union.value, c_window.value
+            _, st_weak, _ = log_catchup_all(spec, d_weak, log, states, W)
+            assert c_union.value == u0
+            assert c_window.value == w0 + 1
+
+            # explicit force (the engine='combined' caller asserting
+            # the contract) still routes the weak model through union
+            log, states = fresh()
+            u0 = c_union.value
+            _, st_forced, _ = log_catchup_all(
+                spec, d_weak, log, states, W, union=True
+            )
+            assert c_union.value == u0 + 1
+        finally:
+            if not was_enabled:
+                reg.disable()
+        for a, b, c in zip(jax.tree.leaves(st_canon),
+                           jax.tree.leaves(st_weak),
+                           jax.tree.leaves(st_forced)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+    def test_auto_engine_honest_for_plan_only_weak_model(self):
+        # a plan/merge-only model WITHOUT the canonical opt-in has no
+        # combined tier that can actually run outside lock-step, so
+        # engine='auto' must resolve (and report) 'scan', not a
+        # 'combined' label whose every round falls through to the scan;
+        # engine='combined' remains the explicit force
+        import dataclasses
+
+        from node_replication_tpu.core.replica import NodeReplicated
+
+        d = make_hashmap(16)
+        weak_plan_only = dataclasses.replace(
+            d, window_apply=None, window_canonical=False
+        )
+        nr = NodeReplicated(weak_plan_only, n_replicas=2,
+                            log_entries=64, gc_slack=8)
+        assert nr.engine == "scan"
+        forced = NodeReplicated(weak_plan_only, n_replicas=2,
+                                log_entries=64, gc_slack=8,
+                                engine="combined")
+        assert forced.engine == "combined"
+        for inst in (nr, forced):
+            t = inst.register(0)
+            for k in range(6):
+                assert inst.execute_mut((HM_PUT, k, k + 50), t) == 0
+            inst.sync()
+            assert inst.replicas_equal()
+            assert inst.execute((HM_GET, 3), t) == 53
+
     def test_node_replicated_engines_agree(self):
         # whole-wrapper drive: per-op API with interleaved sync on both
         # engines, responses and final states bit-equal
