@@ -48,6 +48,195 @@ from node_replication_tpu.models import HM_GET, HM_PUT, make_hashmap
 from node_replication_tpu.utils.fence import fence
 
 
+def serve_main(args) -> int:
+    """`--serve`: benchmark the serving frontend (ISSUE 3).
+
+    Phase 1 (closed loop, sequence-verified): `--serve-clients` OS
+    threads drive `--serve-ops` fetch-and-set ops through a
+    `ServeFrontend` over the seqreg model (`models/seqreg.py`); client
+    `c` owns register `c` and writes `1..N` in order, so every
+    response must equal the previous value — a lost, duplicated, or
+    reordered response is a hard failure (exit 1), which is the CI
+    serve-smoke gate. Reports client-perceived p50/p95/p99 latency and
+    throughput.
+
+    Phase 2 (open loop, overload probe): a deliberately tiny admission
+    queue under an arrival rate far above service capacity must
+    produce typed `Overloaded` rejections — counted both by the
+    frontend and the `serve.shed` obs metric — while memory stays
+    bounded by the queue depth. Zero sheds under pressure, or any
+    untyped failure, is a failure.
+
+    Both phases append rows to `serve_benchmarks.csv` and the combined
+    result prints as one JSON line (p50/p95/p99 + shed-rate next to
+    throughput, the BENCH artifact shape).
+    """
+    from node_replication_tpu import NodeReplicated
+    from node_replication_tpu.harness.mkbench import (
+        append_serve_csv,
+        measure_serve,
+        serve_rows,
+    )
+    from node_replication_tpu.models import SR_GET, SR_SET, make_seqreg
+    from node_replication_tpu.obs.metrics import get_registry
+    from node_replication_tpu.serve import (
+        RetryPolicy,
+        ServeConfig,
+        ServeFrontend,
+    )
+
+    reg = get_registry()
+    reg.enable()  # sheds must land in obs metrics (acceptance gate)
+    clients = args.serve_clients
+    per_client = max(1, args.serve_ops // clients)
+    n_ops = per_client * clients
+    failures: list[str] = []
+    csv_out: list[dict] = []
+
+    # ---- phase 1: closed-loop, sequence-verified -------------------
+    nr = NodeReplicated(
+        make_seqreg(clients),
+        n_replicas=args.serve_replicas,
+        log_entries=4096,
+        gc_slack=256,
+        exec_window=256,
+    )
+    cfg = ServeConfig(
+        queue_depth=args.serve_queue_depth,
+        batch_max_ops=args.serve_batch,
+        batch_linger_s=args.serve_linger,
+    )
+
+    def op_of(c, i):
+        return (SR_SET, c, i + 1)
+
+    def check(c, i, resp):
+        if resp != i:
+            return (f"client {c} op {i}: expected previous value "
+                    f"{i}, got {resp} (lost/dup/reordered)")
+        return None
+
+    with ServeFrontend(nr, cfg) as fe:
+        res = measure_serve(
+            fe, op_of, n_ops, clients, mode="closed",
+            retry=RetryPolicy(), check=check, name="seqreg-closed",
+        )
+        finals = [fe.read((SR_GET, c), rid=fe.rids[c % len(fe.rids)])
+                  for c in range(clients)]
+    for c, v in enumerate(finals):
+        if v != per_client:
+            failures.append(
+                f"client {c}: final register {v} != {per_client}"
+            )
+    nr.sync()
+    if not nr.replicas_equal():
+        failures.append("replicas diverged after closed-loop run")
+    if res.completed != n_ops:
+        failures.append(
+            f"lost responses: completed {res.completed} != {n_ops}"
+        )
+    # oracle violations (lost/dup/reordered) AND transport failures
+    # (nothing may shed or deadline out of the verified closed run)
+    for c, i, msg in (res.errors + res.transport_errors)[:10]:
+        failures.append(msg)
+    csv_out.extend(serve_rows("bench", res))
+
+    # ---- phase 2: open-loop overload probe -------------------------
+    overload = None
+    if args.serve_overload_ops > 0:
+        nr2 = NodeReplicated(
+            make_seqreg(clients), n_replicas=1,
+            log_entries=4096, gc_slack=256, exec_window=256,
+        )
+        shed_before = reg.counter("serve.shed").value
+        with ServeFrontend(
+            nr2,
+            ServeConfig(queue_depth=4, batch_max_ops=8,
+                        batch_linger_s=0.005),
+        ) as fe2:
+            res2 = measure_serve(
+                fe2, op_of, args.serve_overload_ops, clients,
+                mode="open", rate=args.serve_overload_rate,
+                name="seqreg-overload",
+            )
+            depth_now = fe2.stats()["queued"]
+        shed_metric = reg.counter("serve.shed").value - shed_before
+        if res2.shed <= 0:
+            failures.append(
+                "overload probe produced no Overloaded rejections "
+                "(admission control not engaging)"
+            )
+        if shed_metric != res2.shed:
+            failures.append(
+                f"obs serve.shed counter {shed_metric} != frontend "
+                f"shed count {res2.shed}"
+            )
+        if res2.accepted + res2.shed != res2.attempts:
+            failures.append(
+                f"accounting leak: accepted {res2.accepted} + shed "
+                f"{res2.shed} != attempts {res2.attempts}"
+            )
+        if res2.completed + res2.deadline_missed != res2.accepted:
+            failures.append(
+                f"dropped responses: completed {res2.completed} + "
+                f"missed {res2.deadline_missed} != accepted "
+                f"{res2.accepted}"
+            )
+        overload = {
+            "attempts": res2.attempts,
+            "accepted": res2.accepted,
+            "completed": res2.completed,
+            "shed": res2.shed,
+            "shed_rate": round(res2.shed_rate, 4),
+            "metrics_shed_counter": shed_metric,
+            "queue_depth_cap": 4,
+            "queued_after_drain": depth_now,
+            "p95_ms": round(res2.percentile_ms(95), 3),
+        }
+        csv_out.extend(serve_rows("bench", res2))
+
+    append_serve_csv(args.serve_out, csv_out)
+    print(json.dumps({
+        "metric": "serve_seqreg_closed_loop",
+        "value": round(res.percentile_ms(95), 3),
+        "unit": "p95_ms",
+        "clients": clients,
+        "ops": n_ops,
+        "throughput_ops_per_sec": round(res.throughput, 1),
+        "p50_ms": round(res.percentile_ms(50), 3),
+        "p95_ms": round(res.percentile_ms(95), 3),
+        "p99_ms": round(res.percentile_ms(99), 3),
+        "shed": res.shed,
+        "shed_rate": round(res.shed_rate, 4),
+        "deadline_miss": res.deadline_missed,
+        "verified": {
+            "completed": res.completed,
+            "lost": n_ops - res.completed,
+            "sequence_errors": len(res.errors),
+            "transport_errors": len(res.transport_errors),
+            "replicas_equal": not any(
+                "diverged" in f for f in failures
+            ),
+        },
+        "overload": overload,
+    }))
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# serve OK: {n_ops} sequence-verified ops from {clients} "
+        f"clients, zero lost/duplicated; "
+        f"p50/p95/p99 = {res.percentile_ms(50):.2f}/"
+        f"{res.percentile_ms(95):.2f}/{res.percentile_ms(99):.2f} ms"
+        + (f"; overload shed {overload['shed']}/"
+           f"{overload['attempts']} (typed, metered)"
+           if overload else ""),
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--replicas", type=int, default=4096)
@@ -82,9 +271,39 @@ def main():
                    help="measurement windows to try before accepting a "
                         "contended one (the cleanest attempt is "
                         "reported either way)")
+    serve = p.add_argument_group(
+        "serve", "serve-frontend benchmark (--serve): a closed-loop "
+                 "sequence-verified run (zero lost/duplicated "
+                 "responses, p50/p95/p99 latency) plus an open-loop "
+                 "overload probe demonstrating typed backpressure")
+    serve.add_argument("--serve", action="store_true",
+                       help="run the serve benchmark instead of the "
+                            "replay flagship")
+    serve.add_argument("--serve-clients", type=int, default=8,
+                       help="client OS threads")
+    serve.add_argument("--serve-ops", type=int, default=10_000,
+                       help="total sequence-numbered ops across clients")
+    serve.add_argument("--serve-replicas", type=int, default=2)
+    serve.add_argument("--serve-queue-depth", type=int, default=256,
+                       help="admission bound per replica (closed run)")
+    serve.add_argument("--serve-batch", type=int, default=64,
+                       help="combiner batch size trigger")
+    serve.add_argument("--serve-linger", type=float, default=0.001,
+                       help="batch deadline trigger, seconds")
+    serve.add_argument("--serve-overload-ops", type=int, default=2000,
+                       help="open-loop submissions in the overload "
+                            "probe (0 disables the probe)")
+    serve.add_argument("--serve-overload-rate", type=float,
+                       default=20_000.0,
+                       help="open-loop arrival rate (ops/sec) for the "
+                            "overload probe")
+    serve.add_argument("--serve-out", default=".",
+                       help="directory for serve_benchmarks.csv")
     args = p.parse_args()
     if args.max_attempts < 1:
         p.error("--max-attempts must be >= 1")
+    if args.serve:
+        sys.exit(serve_main(args))
     if args.pallas:
         if args.path not in ("auto", "pallas"):
             p.error(f"--pallas conflicts with --path {args.path}")

@@ -58,6 +58,13 @@ from node_replication_tpu.core.replica import (  # noqa: E402
     ReplicaToken,
 )
 from node_replication_tpu.core.step import make_step  # noqa: E402
+from node_replication_tpu.serve import (  # noqa: E402
+    DeadlineExceeded,
+    FrontendClosed,
+    Overloaded,
+    ServeConfig,
+    ServeFrontend,
+)
 
 __all__ = [
     "Dispatch",
@@ -80,6 +87,11 @@ __all__ = [
     "NodeReplicated",
     "ReplicaToken",
     "make_step",
+    "DeadlineExceeded",
+    "FrontendClosed",
+    "Overloaded",
+    "ServeConfig",
+    "ServeFrontend",
 ]
 
 __version__ = "0.1.0"

@@ -670,6 +670,173 @@ def lock_discipline(mod: ModuleInfo,
 
 
 # --------------------------------------------------------------------------
+# blocking-in-handler
+# --------------------------------------------------------------------------
+
+_HANDLER_KWARGS = ("callback", "on_done", "on_response")
+_HANDLER_REGISTRARS = ("add_done_callback",)
+# kwarg-based registration counts only on serve-shaped calls
+# (frontend.submit/call): an unscoped `callback=` match would drag
+# third-party callback APIs (scipy's `minimize(..., callback=)`,
+# timers, ...) under an ERROR-severity serve rule
+_HANDLER_KWARG_METHODS = ("submit", "call")
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep() stalls the batch worker",
+    "jax.block_until_ready": "host sync stalls the batch worker",
+    "jax.device_get": "device->host transfer stalls the batch worker",
+    "numpy.asarray": "host materialization stalls the batch worker",
+    "numpy.array": "host materialization stalls the batch worker",
+}
+_BLOCKING_METHODS = {
+    "block_until_ready": "host sync stalls the batch worker",
+    "item": "device->host scalar readback stalls the batch worker",
+    "result": "waiting on a future from the worker thread that must "
+              "resolve it is a deadlock",
+    "wait": "a blocking wait stalls the batch worker",
+    "sleep": "sleeping stalls the batch worker",
+}
+
+
+def _handler_functions(mod: ModuleInfo) -> dict[str, ast.AST]:
+    """name/id -> function node for every serve handler in the module:
+    arguments to `<x>.add_done_callback(...)` and values of
+    `callback=`/`on_done=`/`on_response=` kwargs, resolved to same-
+    module defs (or inline lambdas) — `self._on_done`-style bound
+    methods resolve by their method name — CLOSED transitively over
+    same-module calls (plain `helper()` and `self._helper()` alike):
+    a handler that delegates its sleep to a helper is still a firing
+    handler."""
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+        elif isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Lambda
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    defs[tgt.id] = node.value
+    roots: list[ast.AST] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HANDLER_REGISTRARS
+            and node.args
+        ):
+            roots.append(node.args[0])
+        fname = None
+        if isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            fname = node.func.id
+        if fname in _HANDLER_KWARG_METHODS:
+            for kw in node.keywords:
+                if kw.arg in _HANDLER_KWARGS:
+                    roots.append(kw.value)
+    handlers: dict[str, ast.AST] = {}
+    queue: list[tuple[str, ast.AST]] = []
+    for i, r in enumerate(roots):
+        if isinstance(r, ast.Lambda):
+            queue.append((f"<lambda#{i}>", r))
+        elif isinstance(r, ast.Name) and r.id in defs:
+            queue.append((r.id, defs[r.id]))
+        elif isinstance(r, ast.Attribute) and r.attr in defs:
+            # bound method: frontend.submit(cb=self._on_done) — match
+            # by method name (the linter's usual name-based precision)
+            queue.append((r.attr, defs[r.attr]))
+    while queue:
+        name, fn = queue.pop()
+        if name in handlers:
+            continue
+        handlers[name] = fn
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if not isinstance(n, ast.Call):
+                    continue
+                callee = None
+                if isinstance(n.func, ast.Name):
+                    callee = n.func.id
+                elif (
+                    isinstance(n.func, ast.Attribute)
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in ("self", "cls")
+                ):
+                    callee = n.func.attr
+                if callee is not None and callee in defs:
+                    queue.append((callee, defs[callee]))
+    return handlers
+
+
+def _first_own_param(fn: ast.AST) -> str | None:
+    """The handler's own-future parameter (first arg, `self`/`cls`
+    skipped): `.result()` on IT is non-blocking by construction —
+    callbacks run only after resolution — and is exempt."""
+    args = fn.args
+    params = [a.arg for a in
+              (list(getattr(args, "posonlyargs", [])) + list(args.args))]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    return params[0] if params else None
+
+
+@rule(
+    "blocking-in-handler", ERROR,
+    "blocking call (sleep/host-sync/future-wait) in a serve handler",
+)
+def blocking_in_handler(mod: ModuleInfo,
+                        project: Project) -> Iterator[Diagnostic]:
+    """Serve done-callbacks run ON the batch worker thread that
+    resolves the future (`serve/future.py`): a handler that sleeps,
+    host-syncs, or waits on ANOTHER future stalls — or deadlocks —
+    the combiner loop for EVERY queued request on that replica.
+    Handlers must only hand work off (append to a queue, set an
+    event, update a counter). Covers functions registered via
+    `add_done_callback(fn)` or passed as `callback=`/`on_done=`/
+    `on_response=` kwargs of serve-shaped calls (`submit`/`call`),
+    including same-module helpers they call. `.result()` on the
+    handler's OWN future argument is the sanctioned read-the-response
+    idiom (already resolved, returns instantly) and does not fire."""
+    for name, fn in sorted(_handler_functions(mod).items()):
+        label = getattr(fn, "name", name)
+        own = _first_own_param(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "result"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == own
+                ):
+                    continue  # own-future read: non-blocking
+                d = mod.dotted(node.func)
+                if d in _BLOCKING_DOTTED:
+                    yield _diag(
+                        mod, node, "blocking-in-handler",
+                        f"{label}: {d}() in a serve handler body — "
+                        f"{_BLOCKING_DOTTED[d]}; hand off to a queue "
+                        f"instead",
+                    )
+                elif (
+                    d is None
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _BLOCKING_METHODS
+                ):
+                    yield _diag(
+                        mod, node, "blocking-in-handler",
+                        f"{label}: .{node.func.attr}() in a serve "
+                        f"handler body — "
+                        f"{_BLOCKING_METHODS[node.func.attr]}; hand "
+                        f"off to a queue instead",
+                    )
+
+
+# --------------------------------------------------------------------------
 # time-in-traced
 # --------------------------------------------------------------------------
 

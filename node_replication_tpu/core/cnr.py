@@ -34,7 +34,9 @@ from node_replication_tpu.core.multilog import (
     multilog_init,
 )
 from node_replication_tpu.core.replica import (
+    BATCH_TID,
     MAX_THREADS_PER_REPLICA,
+    LogTooSmallError,
     ReplicaToken,
     _locked,
     replicate_state,
@@ -250,21 +252,35 @@ class MultiLogReplicated:
         """Drain replica `rid`'s staged ops for `log_idx` (thread order),
         append them to that log, and replay it until `rid` has applied its
         own ops — one log's combiner pass (`cnr/src/replica.rs:673-720`)."""
-        ops: list[tuple[int, int, tuple]] = []
+        ops: list[tuple] = []  # (opcode, *args)
+        tids: list[int] = []
         for tid in range(self._threads_per_replica[rid]):
             q = self._pending[(rid, tid)]
             keep = deque()
             while q:
                 h, opcode, args = q.popleft()
                 if h == log_idx:
-                    ops.append((tid, opcode, args))
+                    ops.append((opcode, *args))
+                    tids.append(tid)
                 else:
                     keep.append((h, opcode, args))
             q.extend(keep)
-        n = len(ops)
-        if n == 0:
+        if not ops:
             self._exec_round(log_idx)
             return
+        self._append_and_replay_log(log_idx, rid, ops, tids)
+
+    @_locked
+    def _append_and_replay_log(self, log_idx: int, rid: int,
+                               ops: list[tuple], tids: list[int],
+                               batch: bool = False) -> None:
+        """Shared per-log combiner-pass tail (`combine` and
+        `execute_mut_batch`'s sub-batches — one protocol, never two):
+        wait for ring space on this log, encode + append, record each
+        op's in-flight response destination, replay the log until
+        replica `rid` has applied its own ops. The lock is reentrant:
+        callers already hold it."""
+        n = len(ops)
         self._combine_rounds[log_idx] += 1
         self._m_combine.inc()
         self._m_batch.observe(n)
@@ -278,15 +294,17 @@ class MultiLogReplicated:
         pos0 = int(np.asarray(self.ml.tail)[log_idx])
         pad = 1 << (max(n, 1) - 1).bit_length()
         opcodes, args, _ = encode_ops(
-            [(o, *a) for _, o, a in ops], self.spec.arg_width, pad_to=pad
+            ops, self.spec.arg_width, pad_to=pad
         )
-        with span("append", log=log_idx, rid=rid, n=n, pos0=pos0) as sp:
+        extra = {"batch": True} if batch else {}
+        with span("append", log=log_idx, rid=rid, n=n, pos0=pos0,
+                  **extra) as sp:
             self.ml = self._append_jit(
                 self.ml, log_idx, opcodes, args, jnp.int64(n)
             )
             sp.fence(self.ml)
         infl = self._inflight.setdefault((rid, log_idx), deque())
-        for j, (tid, _, _) in enumerate(ops):
+        for j, tid in enumerate(tids):
             infl.append((pos0 + j, tid))
         target = pos0 + n
         rounds = 0
@@ -296,6 +314,69 @@ class MultiLogReplicated:
                 self._exec_round(log_idx)
                 rounds = self._watchdog(rounds, log_idx, "combine-replay")
             sp.fence(self.ml, self.states)
+
+    @_locked
+    def execute_mut_batch(self, ops: list[tuple],
+                          rid: int = 0) -> list:
+        """Execute a caller-assembled batch as one combiner pass PER
+        MAPPED LOG and return responses in submission order — the CNR
+        twin of `NodeReplicated.execute_mut_batch` (the serve
+        frontend's entry point).
+
+        Each op routes through the `LogMapper` exactly as `execute_mut`
+        would (`cnr/src/replica.rs:435`); the batch then splits into
+        per-log sub-batches that append and replay one log at a time,
+        in log order. Responses come back through a dedicated deque
+        sink keyed `(rid, BATCH_TID)` and are scattered back to the
+        callers' submission indices, so interleaving with per-thread
+        `execute_mut` traffic on the same replica stays ordered.
+        """
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        n = len(ops)
+        if n == 0:
+            return []
+        sink = self._resps.get((rid, BATCH_TID))
+        if sink is None:
+            sink = deque()
+            self._resps[(rid, BATCH_TID)] = sink
+        groups: dict[int, list[int]] = {}
+        for i, op in enumerate(ops):
+            groups.setdefault(self._map(op), []).append(i)
+        max_batch = self.spec.capacity - self.spec.gc_slack
+        for h, idxs in groups.items():
+            if len(idxs) > max_batch:
+                raise LogTooSmallError(
+                    f"log {h}: sub-batch of {len(idxs)} exceeds "
+                    f"appendable capacity {max_batch}"
+                )
+        out: list = [None] * n
+        try:
+            for h in sorted(groups):
+                idxs = groups[h]
+                m = len(idxs)
+                self._append_and_replay_log(
+                    h, rid, [ops[i] for i in idxs],
+                    [BATCH_TID] * m, batch=True,
+                )
+                assert len(sink) == m, (len(sink), m)
+                for i in idxs:
+                    out[i] = sink.popleft()
+            return out
+        except BaseException:
+            # failed-batch hygiene (the NodeReplicated twin): drop
+            # every pending BATCH_TID delivery for this replica and
+            # clear the sink, so the next batch cannot inherit stale
+            # replies (and a short sink cannot wedge every later
+            # batch on this replica)
+            for key in [(rid, h) for h in groups
+                        if (rid, h) in self._inflight]:
+                self._inflight[key] = deque(
+                    (p, t) for p, t in self._inflight[key]
+                    if t != BATCH_TID
+                )
+            sink.clear()
+            raise
 
     @_locked
     def sync(self, rid: int | None = None) -> None:

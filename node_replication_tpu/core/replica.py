@@ -72,6 +72,49 @@ MAX_THREADS_PER_REPLICA = 256
 # Default static replay window per device round (jit-compiled once).
 DEFAULT_EXEC_WINDOW = 256
 
+# Reserved context key for `execute_mut_batch` response sinks: real
+# thread ids are allocated from 0 upward by `register`, so -1 can never
+# collide, and `combine`'s thread-order drain (`range(threads)`) never
+# visits it.
+BATCH_TID = -1
+
+
+class _BatchSink:
+    """Response sink for caller-assembled batches (`execute_mut_batch`).
+
+    Duck-types the response half of `ops.context.Context`
+    (`enqueue_resps`) so `_exec_round`'s delivery loop needs no special
+    case, but skips the 32-slot pending ring entirely — a serve batch
+    is already assembled and can be any size up to the log's appendable
+    capacity. Guarded by the wrapper's combiner lock like every other
+    context structure.
+    """
+
+    __slots__ = ("_resps", "_inflight")
+
+    def __init__(self) -> None:
+        self._resps: list = []
+        self._inflight = 0
+
+    def expect(self, n: int) -> None:
+        self._inflight += n
+
+    def enqueue_resps(self, resps) -> None:
+        self._inflight -= len(resps)
+        self._resps.extend(resps)
+
+    def take(self) -> list:
+        out = self._resps
+        self._resps = []
+        return out
+
+    def reset(self) -> None:
+        """Discard delivered responses and the expectation count (the
+        failed-batch cleanup path: stale replies must never prefix the
+        next batch's)."""
+        self._resps = []
+        self._inflight = 0
+
 
 class ReplicaToken(NamedTuple):
     """Registration handle (`ReplicaToken`, `nr/src/replica.rs:27-30`).
@@ -448,15 +491,28 @@ class NodeReplicated:
         this replica has applied its own ops (`nr/src/replica.rs:543-595`).
         Responses are delivered to every replica's contexts as replay
         progresses."""
-        ops: list[tuple[int, int, tuple]] = []  # (tid, opcode, args)
+        ops: list[tuple] = []  # (opcode, *args)
+        tids: list[int] = []  # per-op response destination
         for tid in range(self._threads_per_replica[rid]):
             for opcode, args in self._contexts[(rid, tid)].ops():
-                ops.append((tid, opcode, args))
-        n = len(ops)
-        if n == 0:
+                ops.append((opcode, *args))
+                tids.append(tid)
+        if not ops:
             self._exec_round()  # combine with nothing staged still helps
             return
+        self._append_and_replay(ops, rid, tids)
 
+    @_locked
+    def _append_and_replay(self, ops: list[tuple], rid: int,
+                           tids: list[int], batch: bool = False) -> None:
+        """Shared combiner-round tail (one protocol, every caller):
+        wait for ring space (helping GC), encode + append the batch,
+        record each op's in-flight response destination, and replay
+        until replica `rid` has applied its own ops. `combine`,
+        `execute_mut_batch`, and nothing else — serve-path and
+        thread-context rounds must never diverge. The lock is
+        reentrant: callers already hold it."""
+        n = len(ops)
         max_batch = self.spec.capacity - self.spec.gc_slack
         if n > max_batch:
             raise LogTooSmallError(
@@ -471,13 +527,14 @@ class NodeReplicated:
         pos0 = int(self.log.tail)
         pad = 1 << (max(n, 1) - 1).bit_length()
         opcodes, args, _ = encode_ops(
-            [(o, *a) for _, o, a in ops], self.spec.arg_width, pad_to=pad
+            ops, self.spec.arg_width, pad_to=pad
         )
-        with span("append", rid=rid, n=n, pos0=pos0) as sp:
+        extra = {"batch": True} if batch else {}
+        with span("append", rid=rid, n=n, pos0=pos0, **extra) as sp:
             self.log = self._append_call(opcodes, args, n)
             sp.fence(self.log)
         inflight = self._inflight[rid]
-        for j, (tid, _, _) in enumerate(ops):
+        for j, tid in enumerate(tids):
             inflight.append((pos0 + j, tid))
 
         target = pos0 + n
@@ -487,6 +544,56 @@ class NodeReplicated:
                 self._exec_round()
                 rounds = self._watchdog(rounds, "combine-replay")
             sp.fence(self.log, self.states)
+
+    @_locked
+    def execute_mut_batch(self, ops: list[tuple],
+                          rid: int = 0) -> list:
+        """Execute a caller-assembled batch of write ops as ONE
+        flat-combining round and return their responses in op order.
+
+        The serve frontend's entry point (`serve/frontend.py`): the
+        frontend's worker already holds a whole batch, so routing it
+        through per-thread 32-slot contexts would just re-chunk it.
+        This appends the batch directly — one `encode_ops` + one
+        append + one replay-to-target pass, sharing the combiner lock,
+        GC helping loop, and response-delivery machinery with
+        `combine` — and collects responses through a dedicated
+        `_BatchSink` keyed `(rid, BATCH_TID)` so concurrent per-thread
+        contexts on the same replica keep their own deliveries.
+
+        Interleaving with `execute_mut`/`enqueue_mut` from other OS
+        threads is safe: the reentrant lock serializes rounds, and the
+        shared `_inflight` deque orders deliveries by log position.
+        """
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        n = len(ops)
+        if n == 0:
+            return []
+        sink = self._contexts.get((rid, BATCH_TID))
+        if sink is None:
+            sink = _BatchSink()
+            self._contexts[(rid, BATCH_TID)] = sink
+        try:
+            sink.expect(n)
+            self._append_and_replay(
+                list(ops), rid, [BATCH_TID] * n, batch=True
+            )
+            resps = sink.take()
+            assert len(resps) == n, (len(resps), n)
+            return resps
+        except BaseException:
+            # failed-batch hygiene: appended ops stay in the log (they
+            # WILL replay — the log is the source of truth), but their
+            # responses are undeliverable. Drop this batch's pending
+            # deliveries and reset the sink so the NEXT batch's
+            # responses cannot be prefixed with stale replies.
+            self._inflight[rid] = deque(
+                (p, t) for p, t in self._inflight[rid]
+                if t != BATCH_TID
+            )
+            sink.reset()
+            raise
 
     @_locked
     def sync(self, rid: int | None = None) -> None:

@@ -21,6 +21,8 @@ from __future__ import annotations
 import csv
 import dataclasses
 import os
+import random
+import threading
 import time
 from typing import Callable, Sequence
 
@@ -54,6 +56,17 @@ _SKEW_FIELDS = [
     "attempts",
 ]
 BASELINE_CSV = "baseline_comparison.csv"
+SERVE_CSV = "serve_benchmarks.csv"
+# One row per serve measurement (not per-second): client-perceived
+# latency percentiles + admission accounting next to throughput, the
+# serve analog of the reference's `>> X Mops` summaries. `rate` is the
+# open-loop target (blank for closed loop); shed/deadline_miss are the
+# typed-rejection counts the frontend recorded over the run.
+_SERVE_FIELDS = [
+    "name", "mode", "clients", "rate", "duration", "attempts",
+    "accepted", "completed", "shed", "deadline_miss",
+    "throughput_ops", "p50_ms", "p95_ms", "p99_ms",
+]
 # Reference column shape (`benches/mkbench.rs:498-552`) with one addition:
 # `ops` counts *completed client ops* (the reference's Mops semantics,
 # cross-system comparable) and `dispatches` counts *replayed dispatches*
@@ -575,6 +588,233 @@ def sweep_rows(
         }
         for sec, ops in res.per_second
     ]
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One serve-benchmark measurement (closed- or open-loop)."""
+
+    name: str
+    mode: str  # "closed" | "open"
+    clients: int
+    rate: float | None  # open-loop target ops/sec (None for closed)
+    duration_s: float
+    latencies_s: list  # completed ops only, client-perceived seconds
+    attempts: int  # submissions tried (accepted + shed)
+    accepted: int
+    completed: int
+    shed: int
+    deadline_missed: int
+    errors: list  # (client, op_index, message) from the CHECKER only
+    # typed ServeError failures (retry-exhausted Overloaded, deadline
+    # misses, closed frontend) — transport outcomes, NOT oracle
+    # violations; kept apart so `errors` can gate linearizability
+    transport_errors: list
+
+    def percentile_ms(self, p: float) -> float:
+        if not self.latencies_s:
+            return 0.0
+        return float(
+            np.percentile(np.asarray(self.latencies_s), p)
+        ) * 1e3
+
+    @property
+    def throughput(self) -> float:
+        """Completed client ops per second over the measured wall."""
+        return self.completed / self.duration_s if self.duration_s else 0.0
+
+    @property
+    def shed_rate(self) -> float:
+        return self.shed / self.attempts if self.attempts else 0.0
+
+
+def measure_serve(
+    frontend,
+    op_of: Callable[[int, int], tuple],
+    n_ops: int,
+    clients: int,
+    mode: str = "closed",
+    rate: float | None = None,
+    retry=None,
+    rid_of: Callable[[int], int] | None = None,
+    check: Callable[[int, int, int], str | None] | None = None,
+    name: str = "serve",
+) -> ServeResult:
+    """Drive a `ServeFrontend` from `clients` OS threads and measure
+    client-perceived latency (the serve analog of the reference's
+    per-thread measurement loops, `benches/mkbench.rs:592-604`).
+
+    - `op_of(client, i)` builds op `i` of client `client`
+      (`i` in `[0, n_ops // clients)`); `rid_of(client)` picks the
+      submission replica (defaults to round-robin over the frontend's
+      served rids).
+    - **closed loop** (`mode="closed"`): each client submits, waits for
+      the response, then issues its next op; `retry` (a
+      `serve.client.RetryPolicy`) re-submits `Overloaded` rejections
+      with backoff, and the recorded latency spans the FULL op
+      (backoff included — what a closed-loop caller experiences).
+    - **open loop** (`mode="open"`, requires `rate`): each client
+      submits at its share of `rate` ops/sec without waiting;
+      `Overloaded` sheds the op (no retry — open-loop arrivals don't
+      pause), and latency is harvested from the resolved futures after
+      a final `drain()`.
+    - `check(client, i, resp)` returns an error string for a wrong
+      response (None = ok) — the sequence-numbered no-loss/no-dup
+      verification hook (`models/seqreg.py`).
+    """
+    if mode not in ("closed", "open"):
+        raise ValueError(f"unknown serve mode {mode!r}")
+    if mode == "open" and not rate:
+        raise ValueError("open-loop serve measurement needs a rate")
+    from node_replication_tpu.serve import (
+        Overloaded,
+        ServeError,
+        call_with_retry,
+    )
+
+    rids = frontend.rids
+    if rid_of is None:
+        rid_of = lambda c: rids[c % len(rids)]  # noqa: E731
+    per_client = n_ops // clients
+    lat_lock = threading.Lock()
+    latencies: list[float] = []
+    errors: list[tuple] = []
+    transport: list[tuple] = []
+    attempts = [0] * clients
+    open_futs: list[list] = [[] for _ in range(clients)]
+
+    def record(lat_s: float | None, err, kind=errors) -> None:
+        with lat_lock:
+            if lat_s is not None:
+                latencies.append(lat_s)
+            if err is not None:
+                kind.append(err)
+
+    def closed_client(c: int) -> None:
+        rng = random.Random(0xC0FFEE + c)
+        shed_seen = [0]
+
+        def on_shed(attempt, delay):
+            shed_seen[0] += 1
+
+        rid = rid_of(c)
+        exhausted = 0
+        for i in range(per_client):
+            op = op_of(c, i)
+            t0 = time.monotonic()
+            try:
+                if retry is not None:
+                    resp = call_with_retry(
+                        frontend, op, rid=rid, policy=retry, rng=rng,
+                        on_shed=on_shed,
+                    )
+                else:
+                    resp = frontend.call(op, rid=rid)
+            except ServeError as e:
+                if retry is not None and isinstance(e, Overloaded):
+                    # every one of this op's submissions was a shed
+                    # already counted by on_shed; don't let the
+                    # per_client slot double-count it in `attempts`
+                    exhausted += 1
+                record(None, (c, i, f"{type(e).__name__}: {e}"),
+                       kind=transport)
+                continue
+            lat = time.monotonic() - t0
+            err = check(c, i, resp) if check is not None else None
+            record(lat, (c, i, err) if err else None)
+        attempts[c] = per_client + shed_seen[0] - exhausted
+
+    def open_client(c: int) -> None:
+        rid = rid_of(c)
+        interval = clients / rate
+        tried = 0
+        next_t = time.monotonic()
+        for i in range(per_client):
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(next_t - now)
+            next_t += interval
+            tried += 1
+            try:
+                open_futs[c].append((i, frontend.submit(op_of(c, i),
+                                                        rid=rid)))
+            except Overloaded:
+                pass  # open-loop: shed, move on (frontend counts it)
+        attempts[c] = tried
+
+    before = frontend.stats()
+    target = closed_client if mode == "closed" else open_client
+    threads = [
+        threading.Thread(target=target, args=(c,),
+                         name=f"serve-client-{c}")
+        for c in range(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if mode == "open":
+        frontend.drain()
+    duration = time.perf_counter() - t0
+    if mode == "open":
+        for c, futs in enumerate(open_futs):
+            for i, fut in futs:
+                exc = fut.exception(timeout=5.0)
+                if exc is not None:  # deadline miss / closed
+                    record(None,
+                           (c, i, f"{type(exc).__name__}: {exc}"),
+                           kind=transport)
+                    continue
+                err = (
+                    check(c, i, fut.result()) if check is not None
+                    else None
+                )
+                record(fut.latency_s, (c, i, err) if err else None)
+    after = frontend.stats()
+    delta = {
+        k: after[k] - before[k]
+        for k in ("accepted", "completed", "shed", "deadline_missed")
+    }
+    return ServeResult(
+        name=name,
+        mode=mode,
+        clients=clients,
+        rate=rate,
+        duration_s=duration,
+        latencies_s=latencies,
+        attempts=sum(attempts),
+        accepted=delta["accepted"],
+        completed=delta["completed"],
+        shed=delta["shed"],
+        deadline_missed=delta["deadline_missed"],
+        errors=errors,
+        transport_errors=transport,
+    )
+
+
+def serve_rows(name: str, res: ServeResult) -> list[dict]:
+    """The SERVE_CSV row for one measurement."""
+    return [{
+        "name": f"{name}/{res.name}",
+        "mode": res.mode,
+        "clients": res.clients,
+        "rate": "" if res.rate is None else res.rate,
+        "duration": round(res.duration_s, 3),
+        "attempts": res.attempts,
+        "accepted": res.accepted,
+        "completed": res.completed,
+        "shed": res.shed,
+        "deadline_miss": res.deadline_missed,
+        "throughput_ops": round(res.throughput, 1),
+        "p50_ms": round(res.percentile_ms(50), 3),
+        "p95_ms": round(res.percentile_ms(95), 3),
+        "p99_ms": round(res.percentile_ms(99), 3),
+    }]
+
+
+def append_serve_csv(out_dir: str, rows: list[dict]) -> None:
+    _append_csv(os.path.join(out_dir, SERVE_CSV), _SERVE_FIELDS, rows)
 
 
 def measure_native(

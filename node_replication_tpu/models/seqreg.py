@@ -1,0 +1,54 @@
+"""Sequence-register model: per-slot fetch-and-set, the serve-layer
+correctness oracle.
+
+A dense array of int32 registers where the ONLY write op atomically
+sets a slot and returns its PREVIOUS value. That response makes lost,
+duplicated, and reordered executions all observable from the client
+side: a client that owns slot `s` and writes the values `1, 2, 3, …`
+in order must read back exactly `0, 1, 2, …` — any gap is a lost op,
+any repeat is a duplicate, any other mismatch is a reorder. The serve
+bench (`bench.py --serve`) and the elasticity-under-load test drive
+10k+ ops through the frontend and check every response against this
+invariant (the sequence-numbered linearizability check of ISSUE 3).
+
+Responses depend on the pre-state of each entry, so the model has no
+combined window form on purpose — it exercises the generic per-entry
+scan replay, the faithful analog of the reference's replay loop
+(`nr/src/log.rs:473-524`).
+
+Write opcodes: SR_SET=1 (args slot, v → resp previous value).
+Read opcodes: SR_GET=1 (args slot → resp current value).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from node_replication_tpu.ops.encoding import Dispatch
+
+SR_SET = 1
+SR_GET = 1
+
+
+def make_seqreg(n_slots: int) -> Dispatch:
+    """Build the sequence-register Dispatch over `n_slots` registers
+    (all initially 0). Slots index with `slot % n_slots`."""
+
+    def make_state():
+        return {"values": jnp.zeros((n_slots,), jnp.int32)}
+
+    def fetch_and_set(state, args):
+        s = args[0] % n_slots
+        old = state["values"][s]
+        return {"values": state["values"].at[s].set(args[1])}, old
+
+    def get(state, args):
+        return state["values"][args[0] % n_slots]
+
+    return Dispatch(
+        name=f"seqreg{n_slots}",
+        make_state=make_state,
+        write_ops=(fetch_and_set,),
+        read_ops=(get,),
+        arg_width=3,
+    )

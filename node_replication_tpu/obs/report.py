@@ -17,6 +17,10 @@ Sections:
   second), so any runtime trace yields a timeline.
 - **stalls** — watchdog report: stall sites grouped by (where, log),
   with fire counts, max fruitless rounds, and the dormant replicas seen.
+- **serve** (when the trace has `serve-*` events, `serve/frontend.py`)
+  — queue-depth timeline (max observed depth per second), batch-size
+  histogram (power-of-two buckets), and the admission-control counts:
+  shed (`Overloaded`) and deadline-missed requests.
 
 Pure stdlib on purpose: on a machine without jax, copy this file next
 to the trace and run it directly (`python report.py trace.jsonl`) —
@@ -143,6 +147,34 @@ def analyze(events: list[dict]) -> dict:
         s["last_ltail"] = e.get("ltail", s["last_ltail"])
         s["last_tail"] = e.get("tail", s["last_tail"])
 
+    # serve section: batch shape + admission control from serve-* events
+    serve = None
+    batches = [e for e in events if e.get("event") == "serve-batch"]
+    sheds = [e for e in events if e.get("event") == "serve-shed"]
+    misses = [e for e in events
+              if e.get("event") == "serve-deadline-miss"]
+    if batches or sheds or misses:
+        sizes = sorted(int(e.get("n", 0)) for e in batches)
+        size_hist: dict[int, int] = defaultdict(int)
+        for n in sizes:
+            # power-of-two upper-bound buckets: 1, 2, 4, 8, ...
+            size_hist[1 << max(0, n - 1).bit_length()] += 1
+        qdepth: dict[int, int] = {}
+        for e in batches:
+            sec = int(_event_time(e, mono0, ts0))
+            qdepth[sec] = max(qdepth.get(sec, 0),
+                              int(e.get("queue_depth", 0)))
+        serve = {
+            "batches": len(batches),
+            "ops": sum(sizes),
+            "p50_batch": _percentile([float(s) for s in sizes], 0.50),
+            "max_batch": sizes[-1] if sizes else 0,
+            "batch_size_hist": dict(sorted(size_hist.items())),
+            "queue_depth_timeline": dict(sorted(qdepth.items())),
+            "shed": len(sheds),
+            "deadline_miss": sum(int(e.get("n", 1)) for e in misses),
+        }
+
     return {
         "n_events": len(events),
         "event_counts": dict(counts),
@@ -151,6 +183,7 @@ def analyze(events: list[dict]) -> dict:
             "source": source,
             "timeline": dict(sorted(timeline.items())),
         },
+        "serve": serve,
         "stalls": [
             {"where": where, "log": log, **{k: (sorted(v)
                                                if isinstance(v, set)
@@ -202,6 +235,31 @@ def render(report: dict, out=None) -> None:
             w(f"  t+{sec:>4}s {ops:>12} ops  {bar}\n")
         w(f"  total {total} ops over {len(tl)} sampled second(s), "
           f"peak {peak} ops/s\n")
+
+    serve = report.get("serve")
+    if serve:
+        w("\n== serve ==\n")
+        w(f"  {serve['batches']} batch(es), {serve['ops']} ops, "
+          f"p50 batch {serve['p50_batch']:.0f}, "
+          f"max batch {serve['max_batch']}\n")
+        w(f"  shed (Overloaded): {serve['shed']}   "
+          f"deadline-missed: {serve['deadline_miss']}\n")
+        hist = serve["batch_size_hist"]
+        if hist:
+            w("  batch-size histogram (<= bucket):\n")
+            peak = max(hist.values()) or 1
+            for bound in sorted(int(b) for b in hist):
+                n = hist.get(bound, hist.get(str(bound), 0))
+                bar = "#" * max(1, round(30 * n / peak))
+                w(f"    <={bound:>5} {n:>8}  {bar}\n")
+        tl = serve["queue_depth_timeline"]
+        if tl:
+            w("  queue-depth timeline (max observed per second):\n")
+            peak = max(tl.values()) or 1
+            for sec in sorted(int(s) for s in tl):
+                d = tl.get(sec, tl.get(str(sec), 0))
+                bar = "#" * max(1, round(30 * d / peak))
+                w(f"    t+{sec:>4}s depth {d:>6}  {bar}\n")
 
     w("\n== stall report ==\n")
     if not report["stalls"]:

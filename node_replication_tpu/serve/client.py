@@ -1,0 +1,83 @@
+"""Client-side retry-with-backoff over the serve frontend.
+
+`Overloaded` is the frontend's TRANSIENT backpressure signal: the op
+was shed at admission and never touched the log, so resubmitting is
+always safe (exactly-once is preserved — a shed op has no effect to
+duplicate). This module layers the standard client response on top:
+capped exponential backoff with full jitter, giving the combiner time
+to drain between attempts instead of hammering the admission lock.
+
+`DeadlineExceeded` and `FrontendClosed` are NOT retried here —
+deadline'd work is stale by definition and a closed frontend is
+permanent; both propagate to the caller.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+
+from node_replication_tpu.serve.errors import Overloaded
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff with full jitter.
+
+    Attempt i (0-based) sleeps `uniform(0, min(base * 2**i, cap))` —
+    the AWS "full jitter" schedule, which decorrelates a thundering
+    herd of shed clients better than fixed backoff. `max_attempts`
+    bounds total submissions (first try included); attempt
+    `max_attempts` re-raises the final `Overloaded`.
+    """
+
+    max_attempts: int = 8
+    base_backoff_s: float = 0.001
+    max_backoff_s: float = 0.100
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+
+    def backoff_s(self, attempt: int, rng: random.Random) -> float:
+        cap = min(self.base_backoff_s * (2 ** attempt),
+                  self.max_backoff_s)
+        return rng.uniform(0.0, cap)
+
+
+def call_with_retry(
+    frontend,
+    op: tuple,
+    rid: int = 0,
+    policy: RetryPolicy | None = None,
+    deadline_s: float | None = None,
+    timeout: float | None = None,
+    rng: random.Random | None = None,
+    on_shed=None,
+):
+    """Closed-loop `frontend.call` that retries `Overloaded` with
+    backoff. `on_shed(attempt, delay_s)` (optional) observes each
+    rejection — the bench uses it to count retries without threading
+    state through. Returns the op's response; re-raises the last
+    `Overloaded` when the policy is exhausted."""
+    policy = policy or RetryPolicy()
+    rng = rng or random.Random()
+    for attempt in range(policy.max_attempts):
+        try:
+            return frontend.call(op, rid=rid, deadline_s=deadline_s,
+                                 timeout=timeout)
+        except Overloaded:
+            exhausted = attempt + 1 >= policy.max_attempts
+            delay = (
+                0.0 if exhausted else policy.backoff_s(attempt, rng)
+            )
+            if on_shed is not None:
+                # the final, exhausted rejection is observed too —
+                # shed accounting must see every attempt
+                on_shed(attempt, delay)
+            if exhausted:
+                raise
+            if delay > 0:
+                time.sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

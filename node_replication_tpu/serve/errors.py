@@ -1,0 +1,66 @@
+"""Typed serve-layer errors.
+
+The serving frontend (`serve/frontend.py`) communicates every
+production edge as a TYPED exception so callers can route on it
+(retry, shed, fail fast) instead of string-matching. The hierarchy is
+flat under `ServeError`:
+
+- `Overloaded` — admission control rejected the request because the
+  replica's bounded submission queue is full (load shedding, the
+  backpressure signal). Transient by design: `serve/client.py` retries
+  it with backoff.
+- `DeadlineExceeded` — the request's deadline passed before its batch
+  executed; the op was dropped WITHOUT touching the log (a queued
+  request is cancellable right up to batch assembly).
+- `FrontendClosed` — submitted after `close()`, or still queued when a
+  non-draining close tore the queue down. Permanent: retrying cannot
+  help.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(RuntimeError):
+    """Base class for every serve-frontend error."""
+
+
+class Overloaded(ServeError):
+    """Admission queue full: the request was shed at the door.
+
+    Carries the replica id and the queue depth observed at rejection so
+    callers (and the bench's shed-rate accounting) can report where the
+    pressure is. The op was NEVER enqueued — retrying is always safe.
+    """
+
+    def __init__(self, rid: int, depth: int):
+        super().__init__(
+            f"replica {rid} admission queue full ({depth} pending); "
+            f"request shed"
+        )
+        self.rid = rid
+        self.depth = depth
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline passed while it waited in the queue.
+
+    The op was dropped during batch assembly and never appended to the
+    log, so it has no effect — a late caller observes a clean timeout,
+    not a maybe-executed write.
+    """
+
+    def __init__(self, rid: int, late_by_s: float):
+        super().__init__(
+            f"request deadline exceeded on replica {rid} "
+            f"({late_by_s * 1e3:.2f}ms late); op dropped before append"
+        )
+        self.rid = rid
+        self.late_by_s = late_by_s
+
+
+class FrontendClosed(ServeError):
+    """The frontend is closed (or closed non-draining with this request
+    still queued); no further requests are accepted."""
+
+    def __init__(self, detail: str = "frontend closed"):
+        super().__init__(detail)

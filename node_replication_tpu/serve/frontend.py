@@ -1,0 +1,522 @@
+"""Concurrent batching frontend over a replicated-log wrapper.
+
+Turns `NodeReplicated` / `MultiLogReplicated` into a servable system:
+many OS-thread clients submit ops; each replica has a BOUNDED
+submission queue and one dedicated worker — the statically-elected
+combiner for that replica (the reference elects a combiner per
+contention window with a CAS, `nr/src/replica.rs:508-540`; here
+election is the queue→worker ownership, decided once) — that drains
+the queue into an adaptive batch and executes it as a single
+flat-combining round via `execute_mut_batch` (one append + one replay
+pass under the wrapper's reentrant combiner lock, `core/replica.py`).
+
+Production edges, each with a typed signal (`serve/errors.py`):
+
+- **admission control** — the per-replica queue is bounded
+  (`ServeConfig.queue_depth`); a full queue sheds the request with
+  `Overloaded` BEFORE it costs anything. Memory held per replica is
+  therefore `O(queue_depth + batch_max_ops)`, never load-proportional.
+- **deadlines** — a request may carry an absolute deadline; batch
+  assembly drops expired requests with `DeadlineExceeded` *before*
+  appending, so a timed-out op is guaranteed to have had no effect.
+- **backpressure** — clients see `Overloaded` the moment service lags
+  admission; `serve/client.py` layers retry-with-backoff on top for
+  closed-loop callers.
+- **graceful drain** — `close()` stops admission, flushes every queued
+  op through the combiner, resolves all futures, and joins the
+  workers; `close(drain=False)` rejects the backlog instead.
+
+Reads bypass the write queue entirely: `read()` dispatches against the
+caller's replica through the wrapper's read-sync path (`execute`),
+which waits only for this replica to pass the completed tail — read
+latency stays off the write batch, per the reference's read-only path
+(`nr/src/replica.rs:404-410`).
+
+Wire protocol with the wrapper is just the two batch entry points
+(`execute_mut_batch`, `execute`), so the frontend serves NR and CNR
+alike and survives `grow_fleet` — `grow()` adds replicas AND spins up
+their queues/workers while traffic is in flight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Sequence
+
+from node_replication_tpu.obs.metrics import COUNT_BUCKETS, get_registry
+from node_replication_tpu.serve.errors import (
+    DeadlineExceeded,
+    FrontendClosed,
+    Overloaded,
+)
+from node_replication_tpu.serve.future import ServeFuture
+from node_replication_tpu.utils.trace import get_tracer
+
+logger = logging.getLogger("node_replication_tpu")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Frontend tuning knobs (all per replica).
+
+    - `queue_depth` — admission bound; the (queue_depth+1)-th pending
+      request is shed with `Overloaded`.
+    - `batch_max_ops` — size trigger: a batch executes as soon as this
+      many ops are staged.
+    - `batch_linger_s` — deadline trigger: once the first op of a batch
+      arrives, the worker waits at most this long for the batch to
+      fill (0 = drain whatever is queued immediately). The linger is
+      adaptive: it is skipped entirely whenever the queue already holds
+      a full batch, so a saturated queue never pays added latency.
+    - `default_deadline_s` — relative deadline applied to every request
+      that does not pass its own (None = no deadline).
+    - `drain_timeout_s` — how long `close(drain=True)` waits for the
+      workers to flush before giving up and rejecting the remainder.
+    """
+
+    queue_depth: int = 256
+    batch_max_ops: int = 64
+    batch_linger_s: float = 0.002
+    default_deadline_s: float | None = None
+    drain_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        if self.queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        if self.batch_max_ops < 1:
+            raise ValueError("batch_max_ops must be >= 1")
+        if self.batch_linger_s < 0:
+            raise ValueError("batch_linger_s must be >= 0")
+
+
+@dataclasses.dataclass
+class _Request:
+    op: tuple
+    future: ServeFuture
+
+
+class _SubmissionQueue:
+    """Bounded MPSC admission queue for one replica.
+
+    Many client threads `offer`; one worker `take_batch`es. All state
+    lives under one condition (`_lock`): depth check + enqueue is a
+    single critical section, so admission control cannot over-admit
+    under contention. Counters (accepted / shed / completed / missed)
+    live here too so `stats()` needs no frontend-level lock.
+    """
+
+    __slots__ = ("_lock", "_items", "_depth", "_closed", "_in_service",
+                 "accepted", "shed", "completed", "deadline_missed")
+
+    def __init__(self, depth: int):
+        self._lock = threading.Condition()
+        self._items: deque[_Request] = deque()
+        self._depth = depth
+        self._closed = False
+        self._in_service = 0  # ops taken by the worker, not yet finished
+        self.accepted = 0
+        self.shed = 0
+        self.completed = 0
+        self.deadline_missed = 0
+
+    def offer(self, req: _Request) -> bool:
+        """Admit or shed. False = full (caller raises Overloaded)."""
+        with self._lock:
+            if self._closed:
+                raise FrontendClosed()
+            if len(self._items) >= self._depth:
+                self.shed += 1
+                return False
+            self._items.append(req)
+            self.accepted += 1
+            self._lock.notify()
+            return True
+
+    def take_batch(
+        self, max_ops: int, linger_s: float
+    ) -> list[_Request] | None:
+        """Block for the next batch; None = closed and fully drained.
+        Waits for the first op, then lingers up to `linger_s` for the
+        batch to fill — unless a full batch is already queued or the
+        queue is closing (drain fast)."""
+        with self._lock:
+            while not self._items and not self._closed:
+                self._lock.wait()
+            if not self._items:
+                return None  # closed and empty: worker exits
+            if (linger_s > 0 and len(self._items) < max_ops
+                    and not self._closed):
+                t_end = time.monotonic() + linger_s
+                while len(self._items) < max_ops and not self._closed:
+                    rem = t_end - time.monotonic()
+                    if rem <= 0:
+                        break
+                    self._lock.wait(rem)
+            n = min(max_ops, len(self._items))
+            batch = [self._items.popleft() for _ in range(n)]
+            self._in_service = n
+            return batch
+
+    def batch_done(self, completed: int, missed: int) -> None:
+        with self._lock:
+            self._in_service = 0
+            self.completed += completed
+            self.deadline_missed += missed
+            self._lock.notify_all()  # wake wait_idle
+
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def wait_idle(self, timeout: float | None = None) -> bool:
+        """Block until no op is queued or in service (drain barrier)."""
+        t_end = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        with self._lock:
+            while self._items or self._in_service:
+                rem = (
+                    None if t_end is None else t_end - time.monotonic()
+                )
+                if rem is not None and rem <= 0:
+                    return False
+                self._lock.wait(rem)
+            return True
+
+    def close(self, drain: bool) -> list[_Request]:
+        """Stop admission. `drain=True` leaves queued ops for the
+        worker to flush; `drain=False` returns them for rejection."""
+        with self._lock:
+            self._closed = True
+            leftovers: list[_Request] = []
+            if not drain:
+                leftovers = list(self._items)
+                self._items.clear()
+            self._lock.notify_all()
+            return leftovers
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "queued": len(self._items),
+                "in_service": self._in_service,
+                "accepted": self.accepted,
+                "shed": self.shed,
+                "completed": self.completed,
+                "deadline_missed": self.deadline_missed,
+            }
+
+
+class ServeFrontend:
+    """Request frontend over a `NodeReplicated`/`MultiLogReplicated`.
+
+    One bounded queue + one worker (the elected combiner) per replica.
+    Use as a context manager for guaranteed drain-on-exit:
+
+        with ServeFrontend(nr) as fe:
+            fut = fe.submit((HM_PUT, k, v), rid=0)
+            ...
+            assert fut.result() == 0
+
+    `auto_start=False` builds the frontend paused (requests queue up,
+    nothing executes) — deterministic admission/deadline tests and
+    warm-up staging; call `start()` to begin service.
+    """
+
+    def __init__(
+        self,
+        nr,
+        config: ServeConfig | None = None,
+        rids: Sequence[int] | None = None,
+        auto_start: bool = True,
+    ):
+        if not hasattr(nr, "execute_mut_batch"):
+            raise TypeError(
+                f"{type(nr).__name__} has no execute_mut_batch; the "
+                f"frontend serves NodeReplicated/MultiLogReplicated"
+            )
+        self._nr = nr
+        self.cfg = config or ServeConfig()
+        # guards _queues/_workers/_read_tokens/_closed topology changes
+        # (grow, close); the hot submit path reads the dicts lock-free
+        # (GIL-atomic lookups; workers are keyed once at creation)
+        self._lock = threading.Lock()
+        self._closed = False
+        self._started = False
+        self._queues: dict[int, _SubmissionQueue] = {}
+        self._workers: dict[int, threading.Thread] = {}
+        self._read_tokens: dict[int, object] = {}
+        self._depth_gauges: dict[int, object] = {}
+
+        reg = get_registry()
+        self._m_submitted = reg.counter("serve.submitted")
+        self._m_completed = reg.counter("serve.completed")
+        self._m_shed = reg.counter("serve.shed")
+        self._m_miss = reg.counter("serve.deadline_miss")
+        self._m_batches = reg.counter("serve.batches")
+        self._m_batch_size = reg.histogram("serve.batch.size",
+                                           buckets=COUNT_BUCKETS)
+        self._m_batch_dur = reg.histogram("serve.batch.duration_s")
+        self._m_req_lat = reg.histogram("serve.request.latency_s")
+
+        with self._lock:
+            for rid in (rids if rids is not None
+                        else range(nr.n_replicas)):
+                rid = int(rid)
+                if rid in self._queues:
+                    raise ValueError(f"replica {rid} served twice")
+                (self._queues[rid], self._workers[rid],
+                 self._read_tokens[rid],
+                 self._depth_gauges[rid]) = self._new_replica(rid)
+        if auto_start:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _new_replica(self, rid: int):
+        """Build the queue/worker/token/gauge quad for one replica;
+        the CALLER stores them into the topology dicts under `_lock`
+        (so every write to the guarded dicts is visibly locked). The
+        worker starts only via `start()`."""
+        q = _SubmissionQueue(self.cfg.queue_depth)
+        t = threading.Thread(
+            target=self._worker_loop, args=(rid, q),
+            name=f"serve-worker-r{rid}", daemon=True,
+        )
+        token = self._nr.register(rid)
+        gauge = get_registry().gauge(f"serve.queue_depth.r{rid}")
+        return q, t, token, gauge
+
+    def start(self) -> None:
+        """Start every not-yet-running worker (idempotent)."""
+        with self._lock:
+            if self._closed:
+                raise FrontendClosed("cannot start a closed frontend")
+            self._started = True
+            for t in self._workers.values():
+                if not t.is_alive() and not t.ident:
+                    t.start()
+
+    @property
+    def rids(self) -> list[int]:
+        with self._lock:  # grow() can resize the dict mid-iteration
+            return sorted(self._queues)
+
+    def grow(self, k: int = 1) -> list[int]:
+        """Add `k` replicas to the live fleet (`grow_fleet`) and start
+        serving them — queues and workers spin up while existing
+        traffic keeps flowing (elasticity under load)."""
+        if not hasattr(self._nr, "grow_fleet"):
+            raise TypeError(
+                f"{type(self._nr).__name__} has no grow_fleet"
+            )
+        with self._lock:
+            if self._closed:
+                raise FrontendClosed("cannot grow a closed frontend")
+            new_rids = self._nr.grow_fleet(k)
+            for rid in new_rids:
+                rid = int(rid)
+                if rid in self._queues:
+                    raise ValueError(f"replica {rid} served twice")
+                (self._queues[rid], self._workers[rid],
+                 self._read_tokens[rid],
+                 self._depth_gauges[rid]) = self._new_replica(rid)
+            started = self._started
+        get_tracer().emit("serve-grow", rids=list(map(int, new_rids)))
+        if started:
+            self.start()
+        return new_rids
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until every queue is empty and no batch is in flight.
+        Returns False on timeout. Admission stays open — this is a
+        flush barrier, not a shutdown."""
+        with self._lock:  # grow() can resize the dict mid-iteration
+            qs = list(self._queues.values())
+        t_end = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        for q in qs:
+            rem = None if t_end is None else t_end - time.monotonic()
+            if not q.wait_idle(rem):
+                return False
+        return True
+
+    def close(self, drain: bool = True,
+              timeout: float | None = None) -> None:
+        """Stop admission and shut down. `drain=True` (default)
+        flushes every queued op through the combiner first;
+        `drain=False` rejects the backlog with `FrontendClosed`.
+        Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            queues = list(self._queues.items())
+            workers = list(self._workers.values())
+            started = self._started
+        leftovers: list[_Request] = []
+        for _, q in queues:
+            leftovers.extend(q.close(drain))
+        for req in leftovers:
+            req.future._reject(FrontendClosed("closed before service"))
+        if timeout is None:
+            timeout = self.cfg.drain_timeout_s
+        t_end = time.monotonic() + timeout
+        if started:
+            for t in workers:
+                t.join(max(0.0, t_end - time.monotonic()))
+        # paused frontend (never started) or drain timeout: requests
+        # may still sit in the queues — reject, never strand a future
+        for _, q in queues:
+            for req in q.close(drain=False):
+                req.future._reject(
+                    FrontendClosed("closed before service")
+                )
+        get_tracer().emit("serve-close", drained=drain)
+
+    def __enter__(self) -> "ServeFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=exc == (None, None, None))
+
+    # ------------------------------------------------------------ client API
+
+    def submit(self, op: tuple, rid: int = 0,
+               deadline_s: float | None = None) -> ServeFuture:
+        """Stage one write op on replica `rid`; returns its future.
+        Raises `Overloaded` when the admission queue is full and
+        `FrontendClosed` after `close()` — both BEFORE the op can have
+        any effect."""
+        q = self._queues.get(rid)
+        if q is None:
+            raise ValueError(f"replica {rid} is not served "
+                             f"(have {self.rids})")
+        if deadline_s is None:
+            deadline_s = self.cfg.default_deadline_s
+        deadline = (
+            None if deadline_s is None
+            else time.monotonic() + deadline_s
+        )
+        fut = ServeFuture(rid, deadline=deadline)
+        if not q.offer(_Request(op, fut)):
+            self._m_shed.inc()
+            get_tracer().emit("serve-shed", rid=rid,
+                              depth=self.cfg.queue_depth)
+            raise Overloaded(rid, self.cfg.queue_depth)
+        self._m_submitted.inc()
+        return fut
+
+    def call(self, op: tuple, rid: int = 0,
+             deadline_s: float | None = None,
+             timeout: float | None = None):
+        """Closed-loop convenience: `submit` + `result`."""
+        return self.submit(op, rid, deadline_s).result(timeout)
+
+    def read(self, op: tuple, rid: int = 0):
+        """Read against replica `rid` via the wrapper's read-sync path
+        (`execute`): waits only for THIS replica to pass the completed
+        tail, then dispatches locally — never enters the write queue
+        or the log (`nr/src/replica.rs:404-410`)."""
+        token = self._read_tokens.get(rid)
+        if token is None:
+            raise ValueError(f"replica {rid} is not served "
+                             f"(have {self.rids})")
+        return self._nr.execute(op, token)
+
+    def stats(self) -> dict:
+        """Aggregate + per-replica frontend counters (plain ints,
+        independent of the metrics registry's enable flag)."""
+        with self._lock:  # grow() can resize the dict mid-iteration
+            queues = sorted(self._queues.items())
+        per = {rid: q.stats() for rid, q in queues}
+        agg = {
+            k: sum(s[k] for s in per.values())
+            for k in ("queued", "in_service", "accepted", "shed",
+                      "completed", "deadline_missed")
+        }
+        agg["replicas"] = per
+        return agg
+
+    # ------------------------------------------------------------ worker
+
+    def _worker_loop(self, rid: int, q: _SubmissionQueue) -> None:
+        cfg = self.cfg
+        while True:
+            batch = q.take_batch(cfg.batch_max_ops,
+                                 cfg.batch_linger_s)
+            if batch is None:
+                return
+            try:
+                self._run_batch(rid, q, batch)
+            except Exception as e:  # pragma: no cover - last resort
+                logger.exception(
+                    "serve worker r%d: unexpected batch failure", rid
+                )
+                # never strand a caller: reject whatever _run_batch
+                # had not resolved (first resolution wins, so futures
+                # it DID resolve are untouched)
+                for req in batch:
+                    req.future._reject(e)
+                q.batch_done(0, 0)
+
+    def _run_batch(self, rid: int, q: _SubmissionQueue,
+                   batch: list[_Request]) -> None:
+        """One combiner round: sweep expired deadlines, execute the
+        survivors as a single `execute_mut_batch`, resolve futures."""
+        now = time.monotonic()
+        live: list[_Request] = []
+        missed = 0
+        for req in batch:
+            dl = req.future.deadline
+            if dl is not None and now > dl:
+                missed += 1
+                req.future._reject(
+                    DeadlineExceeded(rid, now - dl)
+                )
+            else:
+                live.append(req)
+        if missed:
+            self._m_miss.inc(missed)
+            get_tracer().emit("serve-deadline-miss", rid=rid, n=missed)
+        if not live:
+            q.batch_done(0, missed)
+            return
+        t0 = time.perf_counter()
+        try:
+            resps = self._nr.execute_mut_batch(
+                [req.op for req in live], rid
+            )
+        except Exception as e:
+            for req in live:
+                req.future._reject(e)
+            q.batch_done(0, missed)
+            logger.exception(
+                "serve worker r%d: batch of %d failed", rid, len(live)
+            )
+            return
+        dur = time.perf_counter() - t0
+        for req, resp in zip(live, resps):
+            req.future._resolve(resp)
+            lat = req.future.latency_s
+            if lat is not None:
+                self._m_req_lat.observe(lat)
+        q.batch_done(len(live), missed)
+        depth = q.depth()
+        self._m_batches.inc()
+        self._m_completed.inc(len(live))
+        self._m_batch_size.observe(len(live))
+        self._m_batch_dur.observe(dur)
+        self._depth_gauges[rid].set(depth)
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.emit(
+                "serve-batch", rid=rid, n=len(live), expired=missed,
+                queue_depth=depth, duration_s=dur,
+            )

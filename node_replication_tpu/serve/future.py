@@ -1,0 +1,130 @@
+"""Per-request future: the response handle `ServeFrontend.submit` returns.
+
+A deliberately small, allocation-light future (the stdlib
+`concurrent.futures.Future` carries executor/cancel machinery the serve
+path never uses). One request = one future = exactly one resolution —
+the frontend resolves it with the combiner response or rejects it with
+a typed error (`serve/errors.py`), never both, never twice.
+
+Memory ordering: `_resolve`/`_reject` write the payload under `_lock`
+and then set `_evt`; `result()` waits on `_evt` and reads the payload
+without the lock. The Event is the publication barrier, so the lockless
+read observes a fully-written payload (same idiom as
+`queue.Queue`/`concurrent.futures`).
+
+Done-callbacks run on the WORKER thread that resolves the future (or
+inline on the caller when added after resolution), so they must never
+block — machine-checked by the nrlint `blocking-in-handler` rule.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable
+
+logger = logging.getLogger("node_replication_tpu")
+
+
+class ServeFuture:
+    """Write-once response slot for one submitted op."""
+
+    __slots__ = (
+        "_lock", "_evt", "_value", "_exc", "_callbacks",
+        "rid", "deadline", "t_submit", "t_done",
+    )
+
+    def __init__(self, rid: int, deadline: float | None = None):
+        self._lock = threading.Lock()
+        self._evt = threading.Event()
+        self._value: Any = None
+        self._exc: BaseException | None = None
+        self._callbacks: list[Callable[["ServeFuture"], None]] = []
+        #: replica the request was admitted on
+        self.rid = rid
+        #: absolute monotonic deadline (None = no deadline)
+        self.deadline = deadline
+        #: monotonic admission stamp (set by the frontend at enqueue)
+        self.t_submit = time.monotonic()
+        #: monotonic resolution stamp (None until done)
+        self.t_done: float | None = None
+
+    # ------------------------------------------------------------ caller API
+
+    def done(self) -> bool:
+        return self._evt.is_set()
+
+    def result(self, timeout: float | None = None):
+        """Block until resolved and return the response (or raise the
+        typed rejection). `timeout` bounds THIS wait only — it is not
+        the request deadline, which the frontend enforces queue-side."""
+        if not self._evt.wait(timeout):
+            raise TimeoutError(
+                f"response still pending after {timeout}s "
+                f"(request deadline is enforced by the frontend)"
+            )
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def exception(self, timeout: float | None = None) -> BaseException | None:
+        """Block until resolved; return the rejection (None on success)."""
+        if not self._evt.wait(timeout):
+            raise TimeoutError(f"response still pending after {timeout}s")
+        return self._exc
+
+    @property
+    def latency_s(self) -> float | None:
+        """Admission-to-resolution latency (None until resolved)."""
+        if self.t_done is None:
+            return None
+        return self.t_done - self.t_submit
+
+    def add_done_callback(
+        self, fn: Callable[["ServeFuture"], None]
+    ) -> None:
+        """Run `fn(future)` when the future resolves — on the resolving
+        worker thread, or inline right now if already resolved. Handlers
+        must not block (nrlint `blocking-in-handler`); exceptions are
+        logged and swallowed so one bad handler cannot kill the batch
+        loop."""
+        run_now = False
+        with self._lock:
+            if self._evt.is_set():
+                run_now = True
+            else:
+                self._callbacks.append(fn)
+        if run_now:
+            self._run_callback(fn)
+
+    # ---------------------------------------------------------- frontend API
+
+    def _finish(self, value: Any, exc: BaseException | None) -> bool:
+        """Resolve exactly once; returns False if already resolved
+        (late resolutions — e.g. a drain racing a deadline sweep — are
+        dropped, first writer wins)."""
+        with self._lock:
+            if self._evt.is_set():
+                return False
+            self._value = value
+            self._exc = exc
+            self.t_done = time.monotonic()
+            cbs = self._callbacks
+            self._callbacks = []
+            self._evt.set()
+        for fn in cbs:
+            self._run_callback(fn)
+        return True
+
+    def _resolve(self, value: Any) -> bool:
+        return self._finish(value, None)
+
+    def _reject(self, exc: BaseException) -> bool:
+        return self._finish(None, exc)
+
+    def _run_callback(self, fn) -> None:
+        try:
+            fn(self)
+        except Exception:
+            logger.exception("serve done-callback raised; ignored")

@@ -355,6 +355,128 @@ class TestLockDiscipline:
         assert not firing(diags, "lock-discipline")
 
 
+class TestBlockingInHandler:
+    def test_sleep_in_registered_callback_fires(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import time
+
+            def on_done(fut):
+                time.sleep(0.1)
+
+            def main(fut):
+                fut.add_done_callback(on_done)
+        """)
+        hits = firing(diags, "blocking-in-handler")
+        assert len(hits) == 1 and "time.sleep" in hits[0].message
+
+    def test_future_wait_in_callback_kwarg_fires(self, tmp_path):
+        # waiting on another future from the worker thread that must
+        # resolve it is THE serve deadlock
+        diags = lint_src(tmp_path, """
+            def relay(fut):
+                return other.result()
+
+            def main(frontend, op):
+                frontend.submit(op, callback=relay)
+        """)
+        hits = firing(diags, "blocking-in-handler")
+        assert len(hits) == 1 and ".result()" in hits[0].message
+
+    def test_inline_lambda_handler_fires(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import time
+
+            def main(fut):
+                fut.add_done_callback(lambda f: time.sleep(1))
+        """)
+        assert len(firing(diags, "blocking-in-handler")) == 1
+
+    def test_bound_method_handler_fires(self, tmp_path):
+        # class-based consumers register bound methods; the method
+        # body (and self.-helpers it calls) are handler scope too
+        diags = lint_src(tmp_path, """
+            import time
+
+            class Consumer:
+                def _backoff(self):
+                    time.sleep(0.5)
+
+                def _on_done(self, fut):
+                    self._backoff()
+
+                def main(self, fut):
+                    fut.add_done_callback(self._on_done)
+        """)
+        assert len(firing(diags, "blocking-in-handler")) == 1
+
+    def test_transitive_helper_fires(self, tmp_path):
+        # the handler delegates its blocking to a same-module helper
+        diags = lint_src(tmp_path, """
+            import time
+
+            def backoff():
+                time.sleep(0.5)
+
+            def on_done(fut):
+                backoff()
+
+            def main(fut):
+                fut.add_done_callback(on_done)
+        """)
+        assert len(firing(diags, "blocking-in-handler")) == 1
+
+    def test_own_future_result_is_sanctioned(self, tmp_path):
+        # reading the handler's OWN (already-resolved) future is the
+        # standard done-callback idiom — never a wait
+        diags = lint_src(tmp_path, """
+            OUT = []
+
+            def on_done(fut):
+                OUT.append(fut.result())
+
+            def main(fut, frontend, op):
+                fut.add_done_callback(on_done)
+                fut.add_done_callback(lambda f: OUT.append(f.result()))
+        """)
+        assert not firing(diags, "blocking-in-handler")
+
+    def test_non_serve_callback_api_out_of_scope(self, tmp_path):
+        # callback= kwargs count only on serve-shaped calls
+        # (submit/call): third-party APIs with a callback kwarg must
+        # not trip an ERROR-severity serve rule
+        diags = lint_src(tmp_path, """
+            import time
+            from scipy.optimize import minimize
+
+            def progress(xk):
+                time.sleep(0.1)
+
+            def fit(f, x0):
+                return minimize(f, x0, callback=progress)
+        """)
+        assert not firing(diags, "blocking-in-handler")
+
+    def test_nonblocking_handler_and_free_sleep_clean(self, tmp_path):
+        # hand-off handlers are the sanctioned shape; sleeps in
+        # ordinary (non-handler) code — client backoff loops, benches
+        # — are out of scope
+        diags = lint_src(tmp_path, """
+            import time
+
+            RESULTS = []
+
+            def on_done(fut):
+                RESULTS.append(fut)
+
+            def main(fut):
+                fut.add_done_callback(on_done)
+
+            def client_backoff():
+                time.sleep(0.01)
+        """)
+        assert not firing(diags, "blocking-in-handler")
+
+
 class TestTimeInTraced:
     def test_clock_read_in_jit_fires(self, tmp_path):
         diags = lint_src(tmp_path, """
