@@ -237,6 +237,167 @@ def serve_main(args) -> int:
     return 0
 
 
+def chaos_main(args) -> int:
+    """`--chaos`: the serve bench under injected replica kills (ISSUE 4).
+
+    Reuses the `--serve` closed-loop seqreg verifier — client `c` owns
+    register `c` and writes `1..N`, so every fetch-and-set response
+    must equal the previous value — while a deterministic `FaultPlan`
+    kills replica 1's serve worker mid-run (`serve-batch` site: the
+    injection fires BEFORE the batch touches the log, so every
+    in-flight request is exactly-once retryable). The lifecycle
+    manager quarantines (fencing the corpse out of log GC), repairs by
+    donor-clone + replay, and restarts the worker; clients ride
+    `call_with_retry`'s transparent re-route.
+
+    Hard gates (exit 1): any lost/duplicated/reordered response, a
+    kill that did not fire, a repair that did not complete back to
+    HEALTHY, replicas not bit-identical after the run, or availability
+    below `--chaos-availability-min`. Emits one JSON line with repair
+    p50/p95 latency + availability and appends a
+    `chaos_benchmarks.csv` row.
+    """
+    from node_replication_tpu import NodeReplicated
+    from node_replication_tpu.fault import (
+        HEALTHY,
+        FaultPlan,
+        FaultSpec,
+        ReplicaLifecycleManager,
+    )
+    from node_replication_tpu.harness.mkbench import (
+        append_chaos_csv,
+        chaos_rows,
+        measure_chaos,
+    )
+    from node_replication_tpu.models import SR_SET, make_seqreg
+    from node_replication_tpu.obs.metrics import get_registry
+    from node_replication_tpu.serve import (
+        RetryPolicy,
+        ServeConfig,
+        ServeFrontend,
+    )
+
+    get_registry().enable()
+    clients = args.serve_clients
+    per_client = max(1, args.serve_ops // clients)
+    n_ops = per_client * clients
+    failures: list[str] = []
+
+    nr = NodeReplicated(
+        make_seqreg(clients),
+        n_replicas=max(2, args.serve_replicas),
+        log_entries=4096,
+        gc_slack=256,
+        exec_window=256,
+    )
+    cfg = ServeConfig(
+        queue_depth=args.serve_queue_depth,
+        batch_max_ops=args.serve_batch,
+        batch_linger_s=args.serve_linger,
+        failover=True,
+    )
+    victim = nr.n_replicas - 1
+    plan = FaultPlan(
+        [
+            FaultSpec(site="serve-batch", action="raise", rid=victim,
+                      after=args.chaos_kill_after, count=1)
+            for _ in range(args.chaos_kills)
+        ],
+        seed=args.seed,
+    )
+
+    def op_of(c, i):
+        return (SR_SET, c, i + 1)
+
+    def check(c, i, resp):
+        if resp != i:
+            return (f"client {c} op {i}: expected previous value "
+                    f"{i}, got {resp} (lost/dup/reordered)")
+        return None
+
+    retry = RetryPolicy(max_attempts=args.chaos_retry_attempts,
+                        base_backoff_s=0.001, max_backoff_s=0.25)
+    with ServeFrontend(nr, cfg) as fe:
+        manager = ReplicaLifecycleManager(nr, fe)
+        res = measure_chaos(
+            fe, manager, plan, op_of, n_ops, clients, retry=retry,
+            check=check, name="seqreg-chaos",
+        )
+    s = res.serve
+
+    if not res.fired:
+        failures.append("fault plan never fired (no kill injected)")
+    if len(res.repairs) < len(res.fired):
+        failures.append(
+            f"{len(res.fired)} kill(s) but only {len(res.repairs)} "
+            f"completed repair(s)"
+        )
+    if res.health["states"].count(HEALTHY) != nr.n_replicas:
+        failures.append(
+            f"fleet not fully healthy after settle: "
+            f"{res.health['states']}"
+        )
+    if s.completed != n_ops:
+        failures.append(
+            f"lost responses: completed {s.completed} != {n_ops}"
+        )
+    for c, i, msg in (s.errors + s.transport_errors)[:10]:
+        failures.append(str(msg))
+    if res.availability < args.chaos_availability_min:
+        failures.append(
+            f"availability {res.availability:.4f} < "
+            f"{args.chaos_availability_min}"
+        )
+    # the repaired replica must be bit-identical to a healthy donor's
+    # replay — the repair-by-replay acceptance gate
+    nr.sync()
+    if not nr.replicas_equal():
+        failures.append(
+            "replicas diverged after repair (bit-identity violated)"
+        )
+
+    append_chaos_csv(args.serve_out, chaos_rows("bench", res))
+    print(json.dumps({
+        "metric": "chaos_seqreg_closed_loop",
+        "value": round(res.availability, 6),
+        "unit": "availability",
+        "clients": clients,
+        "ops": n_ops,
+        "kills": len(res.fired),
+        "repairs": len(res.repairs),
+        "rehomed": res.rehomed,
+        "repair_p50_ms": round(res.repair_ms(50), 3),
+        "repair_p95_ms": round(res.repair_ms(95), 3),
+        "repair_max_ms": round(res.repair_ms(100), 3),
+        "throughput_ops_per_sec": round(s.throughput, 1),
+        "p50_ms": round(s.percentile_ms(50), 3),
+        "p95_ms": round(s.percentile_ms(95), 3),
+        "p99_ms": round(s.percentile_ms(99), 3),
+        "verified": {
+            "completed": s.completed,
+            "lost": n_ops - s.completed,
+            "sequence_errors": len(s.errors),
+            "transport_errors": len(s.transport_errors),
+            "replicas_equal": not any("diverged" in f
+                                      for f in failures),
+            "health": res.health["states"],
+        },
+    }))
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# chaos OK: {n_ops} sequence-verified ops from {clients} "
+        f"clients survived {len(res.fired)} replica kill(s); "
+        f"availability {res.availability:.4f}, repair p50/p95 = "
+        f"{res.repair_ms(50):.0f}/{res.repair_ms(95):.0f} ms, "
+        f"{res.rehomed} request(s) re-homed",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--replicas", type=int, default=4096)
@@ -299,9 +460,33 @@ def main():
                             "overload probe")
     serve.add_argument("--serve-out", default=".",
                        help="directory for serve_benchmarks.csv")
+    chaos = p.add_argument_group(
+        "chaos", "fault-injection benchmark (--chaos): the closed-loop "
+                 "sequence-verified serve run with a FaultPlan killing "
+                 "and repairing replicas mid-flight; exits 1 on any "
+                 "lost/duplicated response or unrepaired replica")
+    chaos.add_argument("--chaos", action="store_true",
+                       help="run the chaos benchmark (reuses the "
+                            "--serve-* knobs for load shape)")
+    chaos.add_argument("--chaos-kills", type=int, default=1,
+                       help="how many worker kills to inject")
+    chaos.add_argument("--chaos-kill-after", type=int, default=20,
+                       help="serve-batch hook hits before the kill "
+                            "fires (deterministic schedule position)")
+    chaos.add_argument("--chaos-retry-attempts", type=int, default=16,
+                       help="client retry budget across kill+repair")
+    chaos.add_argument("--chaos-availability-min", type=float,
+                       default=1.0,
+                       help="minimum completed/attempted ratio (the "
+                            "pre-append failover design target is "
+                            "1.0: kills cost latency, not responses)")
     args = p.parse_args()
     if args.max_attempts < 1:
         p.error("--max-attempts must be >= 1")
+    if args.chaos and args.serve:
+        p.error("--chaos and --serve are mutually exclusive")
+    if args.chaos:
+        sys.exit(chaos_main(args))
     if args.serve:
         sys.exit(serve_main(args))
     if args.pallas:

@@ -58,10 +58,17 @@ from node_replication_tpu.core.replica import (  # noqa: E402
     ReplicaToken,
 )
 from node_replication_tpu.core.step import make_step  # noqa: E402
+from node_replication_tpu.fault import (  # noqa: E402
+    FaultPlan,
+    FaultSpec,
+    HealthTracker,
+    ReplicaLifecycleManager,
+)
 from node_replication_tpu.serve import (  # noqa: E402
     DeadlineExceeded,
     FrontendClosed,
     Overloaded,
+    ReplicaFailed,
     ServeConfig,
     ServeFrontend,
 )
@@ -88,8 +95,13 @@ __all__ = [
     "ReplicaToken",
     "make_step",
     "DeadlineExceeded",
+    "FaultPlan",
+    "FaultSpec",
     "FrontendClosed",
+    "HealthTracker",
     "Overloaded",
+    "ReplicaFailed",
+    "ReplicaLifecycleManager",
     "ServeConfig",
     "ServeFrontend",
 ]

@@ -837,6 +837,157 @@ def blocking_in_handler(mod: ModuleInfo,
 
 
 # --------------------------------------------------------------------------
+# swallowed-worker-exception
+# --------------------------------------------------------------------------
+
+# Sinks that legitimately RECORD a worker exception instead of eating
+# it: future/queue delivery methods and the fault/ health-report API.
+_EXC_SINK_ATTRS = frozenset({
+    "_reject", "_resolve", "set_exception", "set_result",
+    "put", "put_nowait", "append", "appendleft", "add",
+    "enqueue_resps", "record",
+})
+_EXC_HEALTH_ATTRS = frozenset({
+    "report_worker_exception", "report_exception", "report_stall",
+    "report_failure", "quarantine", "transition",
+    "_fail_replica", "fail_replica", "on_replica_failed",
+})
+_BROAD_EXC_NAMES = frozenset({"Exception", "BaseException"})
+
+
+def _thread_target_functions(mod: ModuleInfo,
+                             project: Project) -> dict[str, ast.AST]:
+    """name -> function node for every thread-target in the module —
+    `target=` arguments of `threading.Thread(...)` calls (plain names
+    resolved through enclosing scopes and aliases, `self._worker_loop`
+    bound methods by method name, inline lambdas) — closed transitively
+    over same-module calls (`helper()` / `self._helper()`): a worker
+    loop that delegates its batch to a helper still runs that helper on
+    the worker thread."""
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    roots: list[ast.AST] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = mod.dotted(node.func)
+        is_thread = d == "threading.Thread" or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "Thread"
+        )
+        if not is_thread:
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target":
+                roots.append(kw.value)
+    targets: dict[str, ast.AST] = {}
+    queue: list[tuple[str, ast.AST]] = []
+    for i, r in enumerate(roots):
+        if isinstance(r, ast.Lambda):
+            queue.append((f"<lambda#{i}>", r))
+        elif isinstance(r, ast.Name):
+            for fn in project._resolve_callable_name(mod, r, r.id):
+                queue.append((getattr(fn, "name", r.id), fn))
+        elif isinstance(r, ast.Attribute) and r.attr in defs:
+            queue.append((r.attr, defs[r.attr]))
+    while queue:
+        name, fn = queue.pop()
+        if name in targets:
+            continue
+        targets[name] = fn
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if not isinstance(n, ast.Call):
+                    continue
+                callee = None
+                if isinstance(n.func, ast.Name):
+                    callee = n.func.id
+                elif (
+                    isinstance(n.func, ast.Attribute)
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in ("self", "cls")
+                ):
+                    callee = n.func.attr
+                if callee is not None and callee in defs:
+                    queue.append((callee, defs[callee]))
+    return targets
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    """`except:`, `except Exception:`, `except BaseException:` (alone
+    or in a tuple)."""
+    t = handler.type
+    if t is None:
+        return True
+    exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in exprs:
+        name = None
+        if isinstance(e, ast.Name):
+            name = e.id
+        elif isinstance(e, ast.Attribute):
+            name = e.attr
+        if name in _BROAD_EXC_NAMES:
+            return True
+    return False
+
+
+def _handler_records_failure(handler: ast.ExceptHandler) -> bool:
+    """The handler body re-raises, records to a future/queue sink, or
+    calls a health-report API — any of which surfaces the failure."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            attr = None
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                attr = node.func.id
+            if attr in _EXC_SINK_ATTRS or attr in _EXC_HEALTH_ATTRS:
+                return True
+    return False
+
+
+@rule(
+    "swallowed-worker-exception", ERROR,
+    "broad except in a thread-target/worker-loop swallows the failure",
+)
+def swallowed_worker_exception(mod: ModuleInfo,
+                               project: Project) -> Iterator[Diagnostic]:
+    """A `threading.Thread` target (or a helper it calls on the worker
+    thread) that catches `except:` / `except Exception:` and neither
+    re-raises, records to a future/sink (`_reject`, `set_exception`,
+    `put`, ...), nor reports to the health API
+    (`report_worker_exception`, `_fail_replica`, ...) eats the replica
+    failure silently — the exact pattern that turns a dead serve
+    worker into an unexplained hang (`serve/frontend.py` worker
+    contract; `fault/health.py` is the sanctioned report path).
+    Logging alone does not count: a log line resolves no future and
+    quarantines no replica."""
+    for name, fn in sorted(_thread_target_functions(mod,
+                                                    project).items()):
+        label = getattr(fn, "name", name)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad_handler(node):
+                continue
+            if _handler_records_failure(node):
+                continue
+            yield _diag(
+                mod, node, "swallowed-worker-exception",
+                f"{label}: broad except in a worker-thread function "
+                f"neither re-raises, records to a future/sink, nor "
+                f"reports replica health — the failure is silently "
+                f"swallowed; reject the futures or call a "
+                f"health-report API",
+            )
+
+
+# --------------------------------------------------------------------------
 # time-in-traced
 # --------------------------------------------------------------------------
 

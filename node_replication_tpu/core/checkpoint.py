@@ -17,6 +17,13 @@ for the TPU build, where jobs are preempted routinely:
   and replay `[base_pos, tail)` through the same vmapped scan used for
   live replay. Determinism of `Dispatch` transitions makes the result
   bit-identical to the lost states.
+
+The RUNTIME consumer of this recovery model is `fault/`
+(`fault/repair.py`): a quarantined replica is rebuilt live — donor
+snapshot at the donor's ltail, then replay to tail — turning
+recover-by-replay from an offline utility into the repair half of the
+detect/quarantine/repair lifecycle (serve failover rides it through
+`ReplicaLifecycleManager`).
 """
 
 from __future__ import annotations

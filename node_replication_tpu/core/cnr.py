@@ -42,6 +42,7 @@ from node_replication_tpu.core.replica import (
     replicate_state,
     states_equal,
 )
+from node_replication_tpu.fault.inject import fault_hook
 from node_replication_tpu.obs.metrics import COUNT_BUCKETS, get_registry
 from node_replication_tpu.ops.encoding import Dispatch, apply_read, encode_ops
 from node_replication_tpu.utils.trace import get_tracer, span
@@ -233,6 +234,7 @@ class MultiLogReplicated:
         (`cnr/src/replica.rs:599-617`)."""
         h = self._map(op)
         rid = token.rid
+        fault_hook("read-sync", rid, self)
         ctail = int(np.asarray(self.ml.ctail)[h])
         rounds = 0
         while int(np.asarray(self.ml.ltails)[h, rid]) < ctail:
@@ -280,6 +282,7 @@ class MultiLogReplicated:
         op's in-flight response destination, replay the log until
         replica `rid` has applied its own ops. The lock is reentrant:
         callers already hold it."""
+        fault_hook("append", rid, self)
         n = len(ops)
         self._combine_rounds[log_idx] += 1
         self._m_combine.inc()
@@ -479,6 +482,7 @@ class MultiLogReplicated:
 
     @_locked
     def _exec_round(self, log_idx: int) -> None:
+        fault_hook("replay", -1, self)
         # one fused cursor readback per round (see the
         # NodeReplicated._exec_round note on tunnel D2H RTTs)
         cur = np.asarray(

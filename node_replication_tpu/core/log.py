@@ -178,6 +178,43 @@ def log_append(
     )
 
 
+# Far-future sentinel for fenced-cursor masking: past any reachable
+# logical position (int64 cursors; 2^60 leaves headroom for cursor
+# arithmetic without overflow), so masked mins ignore fenced replicas.
+_FAR = 1 << 60
+
+
+def _freeze_limits(log: LogState, limits, fenced):
+    """Fold a fenced mask into the per-replica replay `limits`: a fenced
+    replica is frozen at its own ltail (no replay progress — its state
+    may be corrupt and its cursor must hold still for repair), others
+    keep their caller limit (or no limit)."""
+    fenced = jnp.asarray(fenced, bool)
+    frozen = jnp.where(fenced, log.ltails, jnp.int64(_FAR))
+    if limits is None:
+        return frozen
+    return jnp.minimum(jnp.asarray(limits, jnp.int64), frozen)
+
+
+def _gc_head(log: LogState, new_ltails, fenced):
+    """The GC reduction `head = min(ltails)` with quarantined replicas
+    fenced OUT of the min (`fault/health.py`): one dead replica's
+    frozen cursor must not stall log GC for the fleet. Monotone
+    (clamped at the old head) so a later unfence — repair re-seats the
+    cursor at a healthy donor's ltail, which is >= head — can never
+    move head backwards. `fenced=None` is the exact pre-fault
+    reduction, bit-for-bit."""
+    if fenced is None:
+        return jnp.min(new_ltails)
+    fenced = jnp.asarray(fenced, bool)
+    masked = jnp.where(fenced, jnp.int64(_FAR), new_ltails)
+    # all-fenced degenerate fleet: hold head still rather than min(FAR)
+    return jnp.where(
+        jnp.all(fenced), log.head,
+        jnp.maximum(log.head, jnp.min(masked)),
+    )
+
+
 def gather_window(spec, opcodes_ring, args_ring, start, tail, window: int):
     """Gather `window` ring entries from logical position `start`, masking
     positions at or past `tail` to NOOP (positional liveness — the shared
@@ -245,6 +282,7 @@ def log_exec_all(
     states: PyTree,
     window: int,
     limits: jax.Array | None = None,
+    fenced: jax.Array | None = None,
 ):
     """Replay a static `window` of pending entries into every replica in
     lock-step (vmapped `_exec_one`), then fold in progress bookkeeping:
@@ -258,9 +296,18 @@ def log_exec_all(
     back (`head` stalls at their ltail) until a later un-limited call lets
     them catch up, mirroring `Replica::sync` (`nr/src/replica.rs:469-479`).
 
+    `fenced` (optional, bool[R]) marks QUARANTINED replicas
+    (`fault/health.py`): a fenced replica is frozen at its ltail (its
+    state may be corrupt; repair will discard it) AND excluded from the
+    `head = min(ltails)` GC reduction, so a dead replica cannot stall
+    log GC for the fleet — the runtime difference between a dormant
+    laggard (`limits`) and a quarantined casualty.
+
     Returns `(log, states, resps)` with `resps: int32[R, window]`;
     `resps[r, i]` answers the entry at logical position `old_ltails[r] + i`.
     """
+    if fenced is not None:
+        limits = _freeze_limits(log, limits, fenced)
     if limits is None:
         states, resps, new_ltails = jax.vmap(
             lambda s, lt: _exec_one(spec, d, log, s, lt, window)
@@ -272,7 +319,7 @@ def log_exec_all(
     log = log._replace(
         ltails=new_ltails,
         ctail=jnp.maximum(log.ctail, jnp.max(new_ltails)),
-        head=jnp.min(new_ltails),
+        head=_gc_head(log, new_ltails, fenced),
     )
     return log, states, resps
 
@@ -287,8 +334,16 @@ def log_catchup_all(
     need_resps: bool = True,
     on_trajectory: bool = True,
     union: bool | None = None,
+    fenced: jax.Array | None = None,
 ):
     """Combined catch-up: `log_exec_all` semantics at combined speed.
+
+    `fenced` (optional, bool[R]) carries the quarantine mask
+    (`fault/health.py`) through every tier: fenced replicas are frozen
+    at their ltail, excluded from the GC head reduction, and — on the
+    union-plan tier — excluded from BOTH the plan-donor election and
+    the merge mask (a quarantined replica's state may be corrupt; a
+    plan computed from it, or a merge into it, would be garbage).
 
     `on_trajectory=False` opts OUT of the union-plan tier for hand-built
     fleets whose states are NOT folds of the shared log (tier 1's
@@ -352,19 +407,23 @@ def log_catchup_all(
     if d.window_apply is None and d.window_plan is None:
         # nrlint: disable=obs-in-traced — per-trace tier counter by design
         _m_engine_scan.inc()
-        return log_exec_all(spec, d, log, states, window, limits)
+        return log_exec_all(spec, d, log, states, window, limits,
+                            fenced=fenced)
     take_union = (
         d.window_canonical if union is None else union
     ) and d.window_plan is not None
     if take_union and limits is None and on_trajectory:
         return _catchup_union_plan(spec, d, log, states, window,
-                                   need_resps)
+                                   need_resps, fenced=fenced)
     if d.window_apply is None:
         # nrlint: disable=obs-in-traced — per-trace tier counter by design
         _m_engine_scan.inc()
-        return log_exec_all(spec, d, log, states, window, limits)
+        return log_exec_all(spec, d, log, states, window, limits,
+                            fenced=fenced)
     # nrlint: disable=obs-in-traced — per-trace tier counter by design
     _m_engine_window.inc()
+    if fenced is not None:
+        limits = _freeze_limits(log, limits, fenced)
 
     def one(state, ltail, limit=None):
         eff_tail = (
@@ -396,7 +455,7 @@ def log_catchup_all(
     log = log._replace(
         ltails=new_ltails,
         ctail=jnp.maximum(log.ctail, jnp.max(new_ltails)),
-        head=jnp.min(new_ltails),
+        head=_gc_head(log, new_ltails, fenced),
     )
     return log, states, resps
 
@@ -408,6 +467,7 @@ def _catchup_union_plan(
     states: PyTree,
     window: int,
     need_resps: bool = True,
+    fenced: jax.Array | None = None,
 ):
     """Union-window catch-up (see `log_catchup_all` engine 1).
 
@@ -422,7 +482,21 @@ def _catchup_union_plan(
     which equals the canonical one. Replicas whose cursor is PAST the
     window end must not merge (the plan's final values could rewind
     them); they are masked out and keep their state and cursor.
+
+    `fenced` (bool[R], optional — the quarantine mask, `fault/`): a
+    fenced replica is OFF the shared trajectory by assumption (that is
+    why it was quarantined), so it is excluded from the plan-donor
+    election (`argmin` over unfenced ltails — a corrupt donor would
+    poison the whole fleet's merge), from the union-window start
+    (`m = min` over unfenced), from the merge mask, and from the GC
+    head reduction; its state and cursor hold still for repair.
     """
+    if fenced is not None:
+        fenced = jnp.asarray(fenced, bool)
+    masked_lt = (
+        log.ltails if fenced is None
+        else jnp.where(fenced, jnp.int64(_FAR), log.ltails)
+    )
     # Idle short-circuit (ADVICE r5): when even the most-lagging replica
     # is at the tail there is nothing to replay, and the full
     # plan-sort + vmapped merge below would run for nothing. Host-side
@@ -430,19 +504,31 @@ def _catchup_union_plan(
     # concrete; under jit the cursors are tracers and the caller is
     # responsible for the skip (NodeReplicated._exec_round holds the
     # jit-hot equivalent).
-    if not isinstance(log.tail, jax.core.Tracer) and not isinstance(
-        log.ltails, jax.core.Tracer
+    if (
+        not isinstance(log.tail, jax.core.Tracer)
+        and not isinstance(log.ltails, jax.core.Tracer)
+        and not isinstance(fenced, jax.core.Tracer)
     ):
         lt = np.asarray(log.ltails)
-        # every cursor exactly at tail (the max bound lets corrupted
-        # ltails > tail fall through to the debug-mode checks below)
-        if int(lt.min()) >= int(log.tail) >= int(lt.max()):
+        # every LIVE cursor exactly at tail (the max bound lets
+        # corrupted ltails > tail fall through to the debug-mode
+        # checks below); fenced cursors are frozen and don't count
+        live = lt if fenced is None else lt[~np.asarray(fenced)]
+        idle = bool(
+            live.size
+            and int(live.min()) >= int(log.tail) >= int(live.max())
+        )
+        if idle and fenced is not None:
+            # a freshly fenced laggard may still pin head below the
+            # live min: one device round must run to advance GC
+            idle = int(np.asarray(log.head)) >= int(live.min())
+        if idle:
             _m_idle_skips.inc()
             R = log.ltails.shape[0]
             return log, states, jnp.zeros((R, window), jnp.int32)
     # nrlint: disable=obs-in-traced — per-trace tier counter by design
     _m_engine_union.inc()
-    m = jnp.min(log.ltails)
+    m = jnp.min(masked_lt)
     end = jnp.minimum(m + window, log.tail)
     check(m >= log.head,
           "catch-up window starts at {m}, behind GC head {h}: entries "
@@ -454,11 +540,13 @@ def _catchup_union_plan(
     opcodes, args = gather_window(
         spec, log.opcodes, log.args, m, end, window
     )
-    donor = jnp.argmin(log.ltails)
+    donor = jnp.argmin(masked_lt)
     donor_state = jax.tree.map(lambda x: x[donor], states)
     plan = d.window_plan(donor_state, opcodes, args)
     merged, presps = jax.vmap(lambda s: d.window_merge(s, plan))(states)
     take = log.ltails < end
+    if fenced is not None:
+        take = take & ~fenced
     states = jax.tree.map(
         lambda a, b: jnp.where(
             take.reshape((-1,) + (1,) * (a.ndim - 1)), b, a
@@ -477,14 +565,20 @@ def _catchup_union_plan(
             presps, jnp.clip(offs, 0, window - 1).astype(jnp.int32),
             axis=1,
         )
-        resps = jnp.where(offs < (end - m), resps, 0)
+        # fenced cursors can sit BELOW the (live-min) window start, so
+        # their offsets go negative — mask those rows to 0 alongside
+        # the past-window positions (delivery never consumes a fenced
+        # replica's row anyway: its ltail does not advance)
+        resps = jnp.where((offs >= 0) & (offs < (end - m)), resps, 0)
     else:
         resps = jnp.zeros_like(presps)
     new_ltails = jnp.maximum(log.ltails, end)
+    if fenced is not None:
+        new_ltails = jnp.where(fenced, log.ltails, new_ltails)
     log = log._replace(
         ltails=new_ltails,
         ctail=jnp.maximum(log.ctail, jnp.max(new_ltails)),
-        head=jnp.min(new_ltails),
+        head=_gc_head(log, new_ltails, fenced),
     )
     return log, states, resps
 

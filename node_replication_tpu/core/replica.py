@@ -55,6 +55,7 @@ from node_replication_tpu.core.log import (
     log_init,
     log_space,
 )
+from node_replication_tpu.fault.inject import fault_hook
 from node_replication_tpu.obs.metrics import COUNT_BUCKETS, get_registry
 from node_replication_tpu.ops.context import MAX_PENDING_OPS, Context
 from node_replication_tpu.ops.encoding import (
@@ -129,6 +130,23 @@ class ReplicaToken(NamedTuple):
 
 class LogTooSmallError(RuntimeError):
     """A single batch exceeds the log's appendable capacity."""
+
+
+class ReplicaFencedError(RuntimeError):
+    """The operation targets a fenced (quarantined) replica.
+
+    A fenced replica's replay is frozen and its cursor is excluded from
+    GC (`fault/health.py`), so waiting on its progress would hang
+    forever; appends, reads, and single-replica syncs against it fail
+    fast instead. Repair (`fault/repair.py`) unfences and readmits.
+    """
+
+    def __init__(self, rid: int):
+        super().__init__(
+            f"replica {rid} is fenced (quarantined); repair and "
+            f"unfence it before routing operations to it"
+        )
+        self.rid = rid
 
 
 def _locked(fn):
@@ -231,6 +249,11 @@ class NodeReplicated:
         self._threads_per_replica = [0] * n_replicas
         # Appended-but-unanswered ops per replica: deque[(logical_pos, tid)].
         self._inflight: list[deque] = [deque() for _ in range(n_replicas)]
+        # Quarantine mask (`fault/health.py`): None until the first
+        # `fence_replica` so the no-fault hot path stays byte-identical
+        # (the compiled programs never see a mask argument); a bool[R]
+        # numpy array while any replica is fenced.
+        self._fenced: np.ndarray | None = None
         self._exec_rounds = 0
         # Rounds short-circuited because every replica was already at the
         # tail (empty combine() help, read-sync polling) — the device
@@ -311,12 +334,19 @@ class NodeReplicated:
             partial(log_catchup_all, union=self._union)
             if self.engine == "combined" else log_exec_all
         )
+        def _exec_fenced(log, states, fenced, window):
+            return exec_fn(self.spec, dispatch, log, states,
+                           window=window, fenced=fenced)
+
         if self.debug:
             from node_replication_tpu.utils.checks import checked
 
             self._exec_jit = jax.jit(
                 checked(partial(exec_fn, self.spec, dispatch)),
                 static_argnames=("window",),
+            )
+            self._exec_fenced_jit = jax.jit(
+                checked(_exec_fenced), static_argnames=("window",),
             )
             self._append_jit = jax.jit(
                 checked(partial(log_append, self.spec))
@@ -325,6 +355,13 @@ class NodeReplicated:
             self._exec_jit = jax.jit(
                 partial(exec_fn, self.spec, dispatch),
                 static_argnames=("window",),
+                donate_argnums=(0, 1),
+            )
+            # Fenced twin of the exec program (compiled only if a
+            # replica is ever fenced — jit compilation is lazy, so the
+            # fault-free path never pays for it).
+            self._exec_fenced_jit = jax.jit(
+                _exec_fenced, static_argnames=("window",),
                 donate_argnums=(0, 1),
             )
             self._append_jit = jax.jit(
@@ -386,9 +423,16 @@ class NodeReplicated:
         R = self.n_replicas
         ltails = np.asarray(self.log.ltails)
         if donor is None:
-            donor = int(np.argmax(ltails))
+            # never clone from a fenced (possibly corrupt) replica
+            masked = (
+                ltails if self._fenced is None
+                else np.where(self._fenced, -1, ltails)
+            )
+            donor = int(np.argmax(masked))
         elif not 0 <= donor < R:
             raise ValueError(f"donor replica {donor} out of range")
+        elif self._is_fenced(donor):
+            raise ReplicaFencedError(donor)
         donor_ltail = int(ltails[donor])
 
         self.spec = dataclasses.replace(
@@ -410,6 +454,10 @@ class NodeReplicated:
         )
         self._threads_per_replica.extend([0] * k)
         self._inflight.extend(deque() for _ in range(k))
+        if self._fenced is not None:
+            self._fenced = np.concatenate(
+                [self._fenced, np.zeros(k, bool)]
+            )
         self._build_jits()
         new_rids = list(range(R, R + k))
         get_tracer().emit(
@@ -420,6 +468,102 @@ class NodeReplicated:
             for rid in new_rids:
                 self.sync(rid)
         return new_rids
+
+    # ------------------------------------------------- fencing (fault/)
+
+    def _is_fenced(self, rid: int) -> bool:
+        f = self._fenced
+        return f is not None and bool(f[rid])
+
+    @property
+    def fenced_rids(self) -> list[int]:
+        """Currently fenced (quarantined) replicas."""
+        f = self._fenced
+        return [] if f is None else [int(r) for r in np.where(f)[0]]
+
+    @_locked
+    def fence_replica(self, rid: int) -> None:
+        """Fence `rid` out of the fleet (the QUARANTINED half of the
+        lifecycle machine, `fault/health.py`): its replay freezes at
+        its current ltail, and the GC reduction `head = min(ltails)`
+        skips it (`core/log.py:_gc_head`) so one dead replica cannot
+        stall log GC. Its in-flight responses are dropped (crash
+        semantics, like `recover`): a fenced replica's replay never
+        advances, so they are undeliverable. Idempotent."""
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        if self._fenced is None:
+            self._fenced = np.zeros(self.n_replicas, bool)
+        if self._fenced[rid]:
+            return
+        self._fenced[rid] = True
+        self._inflight[rid] = deque()
+        sink = self._contexts.get((rid, BATCH_TID))
+        if sink is not None:
+            sink.reset()
+        get_tracer().emit(
+            "fault-fence", rid=rid,
+            ltail=int(np.asarray(self.log.ltails)[rid]),
+        )
+
+    @_locked
+    def unfence_replica(self, rid: int) -> None:
+        """Readmit `rid` to replay and GC accounting. The caller must
+        have re-seated its state/cursor first (`clone_replica_from` —
+        a fenced cursor may have fallen behind the GC head, where the
+        log no longer holds its entries). Idempotent."""
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        if self._fenced is None or not self._fenced[rid]:
+            return
+        self._fenced[rid] = False
+        if not self._fenced.any():
+            self._fenced = None  # restore the no-mask hot path
+        get_tracer().emit("fault-unfence", rid=rid)
+
+    @_locked
+    def clone_replica_from(self, rid: int,
+                           donor: int | None = None) -> tuple[int, int]:
+        """Overwrite replica `rid`'s state and cursor with a bit-copy
+        of a healthy donor's — the `grow_fleet` donor-copy invariant
+        applied IN PLACE (a replica's state is the fold of
+        `[0, ltails[r])` from common init, so the copy is a consistent
+        snapshot at exactly the donor's ltail). The first half of
+        repair-by-replay (`fault/repair.py`); the second half is the
+        ordinary catch-up loop after `unfence_replica`. Defaults to
+        the most caught-up unfenced replica. Returns
+        `(donor, donor_ltail)`."""
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        ltails = np.asarray(self.log.ltails)
+        eligible = np.ones(self.n_replicas, bool)
+        eligible[rid] = False
+        if self._fenced is not None:
+            eligible &= ~self._fenced
+        if donor is None:
+            if not eligible.any():
+                raise RuntimeError(
+                    "no healthy donor replica available (all fenced)"
+                )
+            masked = np.where(eligible, ltails, -1)
+            donor = int(np.argmax(masked))
+        elif donor == rid or not 0 <= donor < self.n_replicas:
+            raise ValueError(f"bad donor replica {donor}")
+        elif self._is_fenced(donor):
+            raise ReplicaFencedError(donor)
+        donor_ltail = int(ltails[donor])
+        self.states = jax.tree.map(
+            lambda x: x.at[rid].set(x[donor]), self.states
+        )
+        self.log = self.log._replace(
+            ltails=self.log.ltails.at[rid].set(donor_ltail)
+        )
+        self._inflight[rid] = deque()
+        get_tracer().emit(
+            "fault-clone", rid=rid, donor=donor,
+            donor_ltail=donor_ltail,
+        )
+        return donor, donor_ltail
 
     @_locked
     def execute_mut(self, op: tuple, token: ReplicaToken):
@@ -468,6 +612,9 @@ class NodeReplicated:
         replayed up to the completed tail (helping replay while waiting),
         then dispatch locally against replica state."""
         rid = token.rid
+        if self._is_fenced(rid):
+            raise ReplicaFencedError(rid)
+        fault_hook("read-sync", rid, self)
         ctail = int(self.log.ctail)
         rounds = 0
         while int(np.asarray(self.log.ltails)[rid]) < ctail:
@@ -512,6 +659,12 @@ class NodeReplicated:
         `execute_mut_batch`, and nothing else — serve-path and
         thread-context rounds must never diverge. The lock is
         reentrant: callers already hold it."""
+        if self._is_fenced(rid):
+            # a fenced replica's replay is frozen: waiting for it to
+            # apply its own batch would hang forever — fail fast, the
+            # serve layer re-homes (`ServeFrontend._fail_replica`)
+            raise ReplicaFencedError(rid)
+        fault_hook("append", rid, self)
         n = len(ops)
         max_batch = self.spec.capacity - self.spec.gc_slack
         if n > max_batch:
@@ -598,16 +751,23 @@ class NodeReplicated:
     @_locked
     def sync(self, rid: int | None = None) -> None:
         """Catch replicas up with the log tail (`Replica::sync`,
-        `nr/src/replica.rs:469-479`); `rid=None` syncs all."""
+        `nr/src/replica.rs:469-479`); `rid=None` syncs all UNFENCED
+        replicas (a fenced replica's replay is frozen — waiting on it
+        would never terminate; syncing it explicitly fails fast)."""
+        if rid is not None and self._is_fenced(rid):
+            raise ReplicaFencedError(rid)
         rounds = 0
         while True:
             ltails = np.asarray(self.log.ltails)
             tail = int(self.log.tail)
-            done = (
-                all(int(lt) >= tail for lt in ltails)
-                if rid is None
-                else int(ltails[rid]) >= tail
-            )
+            if rid is None:
+                live = (
+                    ltails if self._fenced is None
+                    else ltails[~self._fenced]
+                )
+                done = all(int(lt) >= tail for lt in live)
+            else:
+                done = int(ltails[rid]) >= tail
             if done:
                 return
             self._exec_round()
@@ -657,6 +817,9 @@ class NodeReplicated:
             window=self.exec_window,
         )
         self._inflight = [deque() for _ in range(self.n_replicas)]
+        # full-fleet rebuild: every replica is freshly consistent, so
+        # any quarantine fencing is moot
+        self._fenced = None
 
     @_locked
     def stats(self) -> dict:
@@ -706,6 +869,7 @@ class NodeReplicated:
                 "max_lag": max(lags) if lags else 0,
                 "threads": list(self._threads_per_replica),
                 "inflight": [len(q) for q in self._inflight],
+                "fenced": self.fenced_rids,
             },
             "exec": {
                 "engine": self.engine,
@@ -757,6 +921,8 @@ class NodeReplicated:
         `min(ltails) == tail` (target <= tail, ctail <= tail), so
         skipping cannot livelock.
         """
+        fault_hook("replay", -1, self)
+        fenced = self._fenced
         # one fused cursor readback (ltails + tail): on the tunneled TPU
         # platform each D2H costs an ~100ms RTT, so two serial fetches
         # would double every round's host-sync latency
@@ -764,12 +930,26 @@ class NodeReplicated:
             jnp.concatenate([self.log.ltails, self.log.tail[None]])
         ).copy()
         ltails_before, tail = cur[:-1], int(cur[-1])
-        # skip only when EVERY cursor sits exactly at the tail: for valid
-        # states min==tail implies that already (ltails <= tail), and the
-        # max bound keeps a corrupted ltail > tail falling through to the
-        # device round so debug-mode invariants still fire on it
-        if (int(ltails_before.min()) >= tail
-                and int(ltails_before.max()) <= tail):
+        # skip only when EVERY live cursor sits exactly at the tail: for
+        # valid states min==tail implies that already (ltails <= tail),
+        # and the max bound keeps a corrupted ltail > tail falling
+        # through to the device round so debug-mode invariants still
+        # fire on it. Fenced cursors are frozen and don't count — but a
+        # freshly fenced laggard may still pin the GC head below the
+        # live min, and only a device round advances head, so the skip
+        # additionally requires head to have caught up.
+        live = (
+            ltails_before if fenced is None
+            else ltails_before[~fenced]
+        )
+        idle = bool(
+            live.size
+            and int(live.min()) >= tail
+            and int(live.max()) <= tail
+        )
+        if idle and fenced is not None:
+            idle = int(np.asarray(self.log.head)) >= int(live.min())
+        if idle:
             self._idle_rounds += 1
             self._m_idle.inc()
             return False
@@ -780,17 +960,30 @@ class NodeReplicated:
         # manual span: the hot path pays one branch when tracing is off
         # (no context-manager frame, no clock read)
         t0 = time.perf_counter() if tracer.enabled else 0.0
+        f_arr = None if fenced is None else jnp.asarray(fenced)
         if self.debug:
             from node_replication_tpu.utils.checks import debug_checks
 
             with debug_checks(True):  # checks live at (re-)trace time
-                err, (self.log, self.states, resps) = self._exec_jit(
-                    self.log, self.states, window=self.exec_window
-                )
+                if f_arr is None:
+                    err, (self.log, self.states, resps) = self._exec_jit(
+                        self.log, self.states, window=self.exec_window
+                    )
+                else:
+                    err, (self.log, self.states, resps) = (
+                        self._exec_fenced_jit(
+                            self.log, self.states, f_arr,
+                            window=self.exec_window,
+                        )
+                    )
             err.throw()
-        else:
+        elif f_arr is None:
             self.log, self.states, resps = self._exec_jit(
                 self.log, self.states, window=self.exec_window
+            )
+        else:
+            self.log, self.states, resps = self._exec_fenced_jit(
+                self.log, self.states, f_arr, window=self.exec_window
             )
         ltails_after = np.asarray(self.log.ltails)
         # worst remaining lag after this round (tail is fixed across the

@@ -57,6 +57,17 @@ _SKEW_FIELDS = [
 ]
 BASELINE_CSV = "baseline_comparison.csv"
 SERVE_CSV = "serve_benchmarks.csv"
+CHAOS_CSV = "chaos_benchmarks.csv"
+# One row per chaos measurement (`bench.py --chaos`): availability
+# (completed/attempts), re-homed request count, and repair-latency
+# percentiles next to the usual serve latency columns. `kills` is how
+# many injected faults actually fired during the window.
+_CHAOS_FIELDS = [
+    "name", "clients", "duration", "attempts", "completed", "lost",
+    "kills", "repairs", "rehomed", "availability",
+    "repair_p50_ms", "repair_p95_ms", "repair_max_ms",
+    "throughput_ops", "p50_ms", "p95_ms", "p99_ms",
+]
 # One row per serve measurement (not per-second): client-perceived
 # latency percentiles + admission accounting next to throughput, the
 # serve analog of the reference's `>> X Mops` summaries. `rate` is the
@@ -815,6 +826,102 @@ def serve_rows(name: str, res: ServeResult) -> list[dict]:
 
 def append_serve_csv(out_dir: str, rows: list[dict]) -> None:
     _append_csv(os.path.join(out_dir, SERVE_CSV), _SERVE_FIELDS, rows)
+
+
+@dataclasses.dataclass
+class ChaosResult:
+    """One chaos measurement: a sequence-verified closed-loop serve run
+    with a `FaultPlan` killing (and the lifecycle manager repairing)
+    replicas mid-flight (`bench.py --chaos`)."""
+
+    serve: "ServeResult"
+    fired: list  # the plan's fired-fault records
+    repairs: list  # ReplicaLifecycleManager repair reports
+    rehomed: int
+    health: dict  # HealthTracker snapshot after the run settles
+
+    @property
+    def availability(self) -> float:
+        """Completed / attempted client ops over the chaos window —
+        with pre-append failover + transparent retry this should be
+        1.0: a kill costs latency, never responses."""
+        a = self.serve.attempts
+        return self.serve.completed / a if a else 0.0
+
+    def repair_ms(self, p: float) -> float:
+        durs = sorted(r["duration_s"] for r in self.repairs)
+        if not durs:
+            return 0.0
+        k = max(0, min(len(durs) - 1,
+                       int(round(p / 100.0 * (len(durs) - 1)))))
+        return durs[k] * 1e3
+
+
+def measure_chaos(
+    frontend,
+    manager,
+    plan,
+    op_of: Callable[[int, int], tuple],
+    n_ops: int,
+    clients: int,
+    retry=None,
+    check: Callable[[int, int, int], str | None] | None = None,
+    name: str = "chaos",
+    settle_timeout_s: float = 60.0,
+) -> ChaosResult:
+    """Closed-loop `measure_serve` with `plan` armed for the duration:
+    injected kills retire replicas, the lifecycle `manager` repairs and
+    readmits them, and clients ride `call_with_retry`'s failover
+    re-route — so the oracle (`check`, usually seqreg) verifies that
+    the kill cost latency, not correctness. Waits for outstanding
+    repairs to settle before reporting."""
+    stats0 = frontend.stats()
+    with plan.armed():
+        res = measure_serve(
+            frontend, op_of, n_ops, clients, mode="closed",
+            retry=retry, check=check, name=name,
+        )
+    if not manager.wait_idle(settle_timeout_s):
+        res.transport_errors.append(
+            (-1, -1, "repair did not settle within "
+                     f"{settle_timeout_s}s")
+        )
+    rehomed = frontend.stats()["rehomed"] - stats0.get("rehomed", 0)
+    return ChaosResult(
+        serve=res,
+        fired=list(plan.fired),
+        repairs=list(manager.repairs),
+        rehomed=rehomed,
+        health=manager.health.snapshot(),
+    )
+
+
+def chaos_rows(name: str, res: ChaosResult) -> list[dict]:
+    """The CHAOS_CSV row for one measurement."""
+    s = res.serve
+    return [{
+        "name": f"{name}/{s.name}",
+        "clients": s.clients,
+        "duration": round(s.duration_s, 3),
+        "attempts": s.attempts,
+        "completed": s.completed,
+        "lost": s.attempts - s.completed,
+        "kills": len(res.fired),
+        "repairs": len(res.repairs),
+        "rehomed": res.rehomed,
+        "availability": round(res.availability, 6),
+        "repair_p50_ms": round(res.repair_ms(50), 3),
+        "repair_p95_ms": round(res.repair_ms(95), 3),
+        "repair_max_ms": round(res.repair_ms(100), 3),
+        "throughput_ops": round(s.throughput, 1),
+        "p50_ms": round(s.percentile_ms(50), 3),
+        "p95_ms": round(s.percentile_ms(95), 3),
+        "p99_ms": round(s.percentile_ms(99), 3),
+    }]
+
+
+def append_chaos_csv(out_dir: str, rows: list[dict]) -> None:
+    _append_csv(os.path.join(out_dir, CHAOS_CSV), _CHAOS_FIELDS, rows)
 
 
 def measure_native(

@@ -21,6 +21,12 @@ Sections:
   — queue-depth timeline (max observed depth per second), batch-size
   histogram (power-of-two buckets), and the admission-control counts:
   shed (`Overloaded`) and deadline-missed requests.
+- **fault** (when the trace has `fault-*` / `serve-rehome` events,
+  `fault/`) — per-replica lifecycle-transition timeline
+  (HEALTHY -> SUSPECT -> QUARANTINED -> REPAIRING -> HEALTHY),
+  repair-duration histogram (power-of-two millisecond buckets) with
+  p50/p95, and the counts the chaos gates watch: injected faults,
+  quarantines, completed repairs, re-homed requests.
 
 Pure stdlib on purpose: on a machine without jax, copy this file next
 to the trace and run it directly (`python report.py trace.jsonl`) —
@@ -175,6 +181,43 @@ def analyze(events: list[dict]) -> dict:
             "deadline_miss": sum(int(e.get("n", 1)) for e in misses),
         }
 
+    # fault section: lifecycle transitions + repair latencies from
+    # fault-* events (fault/health.py, fault/repair.py)
+    fault = None
+    transitions = [e for e in events
+                   if e.get("event") == "fault-transition"]
+    repairs = [e for e in events if e.get("event") == "fault-repair"]
+    injects = [e for e in events if e.get("event") == "fault-inject"]
+    rehomes = [e for e in events if e.get("event") == "serve-rehome"]
+    if transitions or repairs or injects or rehomes:
+        per_rid: dict[int, list] = defaultdict(list)
+        for e in transitions:
+            per_rid[int(e.get("rid", -1))].append((
+                round(_event_time(e, mono0, ts0), 3),
+                e.get("frm", "?"), e.get("to", "?"),
+            ))
+        durs = sorted(float(e.get("duration_s", 0.0)) for e in repairs)
+        repair_hist: dict[int, int] = defaultdict(int)
+        for d in durs:
+            # power-of-two millisecond upper-bound buckets: 1, 2, 4...
+            ms = max(1, int(d * 1e3))
+            repair_hist[1 << max(0, ms - 1).bit_length()] += 1
+        fault = {
+            "injected": len(injects),
+            "quarantines": sum(
+                1 for e in transitions if e.get("to") == "quarantined"
+            ),
+            "repairs": len(repairs),
+            "rehomed": sum(int(e.get("n", 1)) for e in rehomes),
+            "repair_p50_s": _percentile(durs, 0.50),
+            "repair_p95_s": _percentile(durs, 0.95),
+            "repair_max_s": durs[-1] if durs else 0.0,
+            "repair_hist_ms": dict(sorted(repair_hist.items())),
+            "timeline": {
+                rid: trs for rid, trs in sorted(per_rid.items())
+            },
+        }
+
     return {
         "n_events": len(events),
         "event_counts": dict(counts),
@@ -184,6 +227,7 @@ def analyze(events: list[dict]) -> dict:
             "timeline": dict(sorted(timeline.items())),
         },
         "serve": serve,
+        "fault": fault,
         "stalls": [
             {"where": where, "log": log, **{k: (sorted(v)
                                                if isinstance(v, set)
@@ -260,6 +304,34 @@ def render(report: dict, out=None) -> None:
                 d = tl.get(sec, tl.get(str(sec), 0))
                 bar = "#" * max(1, round(30 * d / peak))
                 w(f"    t+{sec:>4}s depth {d:>6}  {bar}\n")
+
+    fault = report.get("fault")
+    if fault:
+        w("\n== fault ==\n")
+        w(f"  injected: {fault['injected']}   "
+          f"quarantines: {fault['quarantines']}   "
+          f"repairs: {fault['repairs']}   "
+          f"re-homed requests: {fault['rehomed']}\n")
+        if fault["repairs"]:
+            w(f"  repair duration p50 {_fmt_s(fault['repair_p50_s'])} "
+              f"p95 {_fmt_s(fault['repair_p95_s'])} "
+              f"max {_fmt_s(fault['repair_max_s'])}\n")
+            hist = fault["repair_hist_ms"]
+            if hist:
+                w("  repair-duration histogram (<= ms bucket):\n")
+                peak = max(hist.values()) or 1
+                for bound in sorted(int(b) for b in hist):
+                    n = hist.get(bound, hist.get(str(bound), 0))
+                    bar = "#" * max(1, round(30 * n / peak))
+                    w(f"    <={bound:>6}ms {n:>6}  {bar}\n")
+        tl = fault["timeline"]
+        if tl:
+            w("  lifecycle timeline (per replica):\n")
+            for rid in sorted(tl, key=int):
+                steps = " -> ".join(
+                    f"{to}@t+{t}s" for t, _frm, to in tl[rid]
+                )
+                w(f"    r{rid}: {steps}\n")
 
     w("\n== stall report ==\n")
     if not report["stalls"]:

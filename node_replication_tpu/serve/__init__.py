@@ -25,6 +25,7 @@ from node_replication_tpu.serve.errors import (
     DeadlineExceeded,
     FrontendClosed,
     Overloaded,
+    ReplicaFailed,
     ServeError,
 )
 from node_replication_tpu.serve.frontend import (
@@ -37,6 +38,7 @@ __all__ = [
     "DeadlineExceeded",
     "FrontendClosed",
     "Overloaded",
+    "ReplicaFailed",
     "RetryPolicy",
     "ServeConfig",
     "ServeError",

@@ -7,6 +7,14 @@ duplicate). This module layers the standard client response on top:
 capped exponential backoff with full jitter, giving the combiner time
 to drain between attempts instead of hammering the admission lock.
 
+`ReplicaFailed` (failover mode, `fault/`) is retried ONLY when the
+frontend proved the op never reached the log
+(`maybe_executed=False`) — and the retry transparently RE-ROUTES to a
+healthy replica (`frontend.healthy_rids()`), so a client survives its
+replica dying mid-conversation without seeing anything but latency. A
+`maybe_executed=True` failure propagates: the op will replay from the
+log and resubmitting could duplicate it.
+
 `DeadlineExceeded` and `FrontendClosed` are NOT retried here —
 deadline'd work is stale by definition and a closed frontend is
 permanent; both propagate to the caller.
@@ -18,7 +26,7 @@ import dataclasses
 import random
 import time
 
-from node_replication_tpu.serve.errors import Overloaded
+from node_replication_tpu.serve.errors import Overloaded, ReplicaFailed
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,28 +64,43 @@ def call_with_retry(
     rng: random.Random | None = None,
     on_shed=None,
 ):
-    """Closed-loop `frontend.call` that retries `Overloaded` with
-    backoff. `on_shed(attempt, delay_s)` (optional) observes each
-    rejection — the bench uses it to count retries without threading
-    state through. Returns the op's response; re-raises the last
-    `Overloaded` when the policy is exhausted."""
+    """Closed-loop `frontend.call` that retries `Overloaded` (with
+    backoff) and retryable `ReplicaFailed` (with backoff AND a
+    re-route to a healthy replica). `on_shed(attempt, delay_s)`
+    (optional) observes each `Overloaded` rejection — the bench uses
+    it to count retries without threading state through. Returns the
+    op's response; re-raises the last transient error when the policy
+    is exhausted."""
     policy = policy or RetryPolicy()
     rng = rng or random.Random()
     for attempt in range(policy.max_attempts):
         try:
             return frontend.call(op, rid=rid, deadline_s=deadline_s,
                                  timeout=timeout)
-        except Overloaded:
+        except (Overloaded, ReplicaFailed) as e:
+            if isinstance(e, ReplicaFailed) and e.maybe_executed:
+                # the op may already be in the log (it WILL replay;
+                # only its response was lost) — resubmitting could
+                # duplicate it, so exactly-once forbids auto-retry
+                raise
             exhausted = attempt + 1 >= policy.max_attempts
             delay = (
                 0.0 if exhausted else policy.backoff_s(attempt, rng)
             )
-            if on_shed is not None:
+            if isinstance(e, Overloaded) and on_shed is not None:
                 # the final, exhausted rejection is observed too —
                 # shed accounting must see every attempt
                 on_shed(attempt, delay)
             if exhausted:
                 raise
+            if isinstance(e, ReplicaFailed):
+                # transparent failover: re-route the resubmission to a
+                # healthy replica when the frontend can name one
+                healthy = getattr(frontend, "healthy_rids", None)
+                if healthy is not None:
+                    alt = [r for r in healthy() if r != e.rid]
+                    if alt:
+                        rid = alt[attempt % len(alt)]
             if delay > 0:
                 time.sleep(delay)
     raise AssertionError("unreachable")  # pragma: no cover

@@ -15,6 +15,14 @@ flat under `ServeError`:
 - `FrontendClosed` — submitted after `close()`, or still queued when a
   non-draining close tore the queue down. Permanent: retrying cannot
   help.
+- `ReplicaFailed` — the replica serving this request died (worker
+  exception, injected fault, quarantine fence). Retryable when the op
+  provably never reached the log (`maybe_executed=False`):
+  `serve/client.py:call_with_retry` then transparently re-routes the
+  op to a healthy replica. When the failure struck after the append
+  (`maybe_executed=True`) the op WILL replay and only its response was
+  lost — resubmitting could duplicate it, so the client must decide
+  (the log is the source of truth; a read can disambiguate).
 """
 
 from __future__ import annotations
@@ -64,3 +72,35 @@ class FrontendClosed(ServeError):
 
     def __init__(self, detail: str = "frontend closed"):
         super().__init__(detail)
+
+
+class ReplicaFailed(ServeError):
+    """The serving replica died under this request (`fault/`).
+
+    `maybe_executed=False` (the in-flight-batch and queued-request
+    failover paths, which fire BEFORE the batch touches the log)
+    guarantees the op had no effect — resubmitting is exactly-once
+    safe, and `call_with_retry` does so automatically, re-routed to a
+    healthy replica. `maybe_executed=True` means the failure struck
+    after the append: the op will replay (the log survives the
+    replica), only its response was lost — automatic retry is refused
+    because it could duplicate the op.
+    """
+
+    def __init__(self, rid: int, cause: BaseException | None = None,
+                 maybe_executed: bool = False):
+        detail = f" ({type(cause).__name__}: {cause})" if cause else ""
+        effect = (
+            "op may have reached the log; response lost"
+            if maybe_executed else "op never reached the log"
+        )
+        super().__init__(
+            f"replica {rid} failed{detail}; {effect}"
+        )
+        self.rid = rid
+        self.cause = cause
+        self.maybe_executed = maybe_executed
+
+    @property
+    def retryable(self) -> bool:
+        return not self.maybe_executed

@@ -25,6 +25,17 @@ Production edges, each with a typed signal (`serve/errors.py`):
 - **graceful drain** — `close()` stops admission, flushes every queued
   op through the combiner, resolves all futures, and joins the
   workers; `close(drain=False)` rejects the backlog instead.
+- **failover** (`ServeConfig.failover=True`, the `fault/` lifecycle
+  integration) — a worker whose batch round throws retires its replica
+  instead of limping: in-flight requests are completed exceptionally
+  with typed `ReplicaFailed` (retryable when the batch provably never
+  reached the log, so `call_with_retry` transparently re-routes),
+  queued requests are re-homed onto a healthy replica's queue, and the
+  `on_replica_failed` callback hands the corpse to the lifecycle
+  manager (`fault/repair.py`) for quarantine + repair-by-replay;
+  `restart_replica` readmits the repaired replica with a fresh queue
+  and worker. Off (default), a failed batch rejects its own futures
+  and the worker keeps serving — the pre-fault behavior.
 
 Reads bypass the write queue entirely: `read()` dispatches against the
 caller's replica through the wrapper's read-sync path (`execute`),
@@ -47,11 +58,14 @@ import time
 from collections import deque
 from typing import Callable, Sequence
 
+from node_replication_tpu.core.replica import ReplicaFencedError
+from node_replication_tpu.fault.inject import FaultError, fault_hook
 from node_replication_tpu.obs.metrics import COUNT_BUCKETS, get_registry
 from node_replication_tpu.serve.errors import (
     DeadlineExceeded,
     FrontendClosed,
     Overloaded,
+    ReplicaFailed,
 )
 from node_replication_tpu.serve.future import ServeFuture
 from node_replication_tpu.utils.trace import get_tracer
@@ -76,6 +90,10 @@ class ServeConfig:
       that does not pass its own (None = no deadline).
     - `drain_timeout_s` — how long `close(drain=True)` waits for the
       workers to flush before giving up and rejecting the remainder.
+    - `failover` — retire a replica whose batch round throws (typed
+      `ReplicaFailed` to in-flight callers, queued requests re-homed,
+      `on_replica_failed` lifecycle callback) instead of rejecting the
+      batch and limping on. See the module docstring and `fault/`.
     """
 
     queue_depth: int = 256
@@ -83,6 +101,7 @@ class ServeConfig:
     batch_linger_s: float = 0.002
     default_deadline_s: float | None = None
     drain_timeout_s: float = 30.0
+    failover: bool = False
 
     def __post_init__(self):
         if self.queue_depth < 1:
@@ -97,6 +116,25 @@ class ServeConfig:
 class _Request:
     op: tuple
     future: ServeFuture
+
+
+class _ReplicaDown(Exception):
+    """Internal worker-loop signal: this batch round killed the
+    replica (failover mode); the loop retires it and exits.
+
+    Carries the batch's unresolved requests so the LOOP can reject
+    them AFTER `_fail_replica` has marked the replica failed and
+    spawned the lifecycle callback — a client that wakes on its
+    `ReplicaFailed` must observe the failover already in motion
+    (`wait_idle` on the manager, `healthy_rids` on the frontend),
+    never a pre-failover limbo."""
+
+    def __init__(self, cause: BaseException, pending: list[_Request],
+                 maybe_executed: bool):
+        super().__init__(str(cause))
+        self.cause = cause
+        self.pending = pending
+        self.maybe_executed = maybe_executed
 
 
 class _SubmissionQueue:
@@ -133,6 +171,20 @@ class _SubmissionQueue:
                 return False
             self._items.append(req)
             self.accepted += 1
+            self._lock.notify()
+            return True
+
+    def readmit(self, req: _Request) -> bool:
+        """Enqueue a request re-homed from a FAILED replica's queue
+        WITHOUT counting a second admission — the original queue
+        already counted it `accepted` (and its counters fold into the
+        frontend aggregates), so `offer` here would double-count.
+        False when closed or full (not a shed: the caller rejects with
+        `ReplicaFailed`, not `Overloaded`)."""
+        with self._lock:
+            if self._closed or len(self._items) >= self._depth:
+                return False
+            self._items.append(req)
             self._lock.notify()
             return True
 
@@ -252,6 +304,15 @@ class ServeFrontend:
         self._workers: dict[int, threading.Thread] = {}
         self._read_tokens: dict[int, object] = {}
         self._depth_gauges: dict[int, object] = {}
+        # failover state: failed rid -> the exception that killed its
+        # worker; counters folded from retired (replaced) queues so
+        # aggregate stats survive a restart's queue swap
+        self._failed: dict[int, BaseException] = {}
+        self._retired: dict[str, int] = {}
+        self._rehomed = 0
+        #: lifecycle callback `fn(rid, exc)` — the `fault/` manager
+        #: installs itself here to quarantine + repair + restart
+        self.on_replica_failed: Callable[[int, BaseException], None] | None = None
 
         reg = get_registry()
         self._m_submitted = reg.counter("serve.submitted")
@@ -259,6 +320,7 @@ class ServeFrontend:
         self._m_shed = reg.counter("serve.shed")
         self._m_miss = reg.counter("serve.deadline_miss")
         self._m_batches = reg.counter("serve.batches")
+        self._m_rehomed = reg.counter("serve.rehomed")
         self._m_batch_size = reg.histogram("serve.batch.size",
                                            buckets=COUNT_BUCKETS)
         self._m_batch_dur = reg.histogram("serve.batch.duration_s")
@@ -332,6 +394,111 @@ class ServeFrontend:
             self.start()
         return new_rids
 
+    # ------------------------------------------------------------ failover
+
+    def healthy_rids(self) -> list[int]:
+        """Served replicas currently accepting admissions (rids minus
+        failed ones) — `call_with_retry`'s re-route domain."""
+        with self._lock:
+            return sorted(r for r in self._queues
+                          if r not in self._failed)
+
+    def _rehome(self, req: _Request,
+                targets: list[_SubmissionQueue]) -> bool:
+        """Move a failed replica's queued request onto a healthy
+        replica's queue (admission-order preserved within the batch of
+        leftovers; `readmit` — the request was already counted
+        accepted once). False when no target admits it."""
+        for q in targets:
+            if q.readmit(req):
+                return True
+        return False
+
+    def _fail_replica(self, rid: int, q: _SubmissionQueue,
+                      exc: BaseException) -> None:
+        """Retire replica `rid` from admission (worker death path):
+        mark it failed, re-home its queued requests onto healthy
+        replicas (rejecting with retryable `ReplicaFailed` only when
+        none admits), and hand the corpse to `on_replica_failed`.
+        Runs on the dying worker thread; idempotent."""
+        with self._lock:
+            already = rid in self._failed
+            if not already:
+                self._failed[rid] = exc
+        if already:
+            return
+        leftovers = q.close(drain=False)
+        # one topology snapshot for the whole leftover batch (per-
+        # request healthy_rids() would hammer the frontend lock from
+        # the dying worker while clients contend on submit)
+        with self._lock:
+            targets = [self._queues[r] for r in sorted(self._queues)
+                       if r != rid and r not in self._failed]
+        rehomed = 0
+        for req in leftovers:
+            if self._rehome(req, targets):
+                rehomed += 1
+            else:
+                req.future._reject(
+                    ReplicaFailed(rid, exc, maybe_executed=False)
+                )
+        with self._lock:
+            self._rehomed += rehomed
+        if rehomed:
+            self._m_rehomed.inc(rehomed)
+            get_tracer().emit("serve-rehome", rid=rid, n=rehomed)
+        get_tracer().emit(
+            "serve-replica-failed", rid=rid, rehomed=rehomed,
+            queued=len(leftovers), cause=type(exc).__name__,
+        )
+        logger.warning(
+            "serve worker r%d retired after %s: %s (%d queued "
+            "request(s) re-homed)", rid, type(exc).__name__, exc,
+            rehomed,
+        )
+        cb = self.on_replica_failed
+        if cb is not None:
+            try:
+                cb(rid, exc)
+            # the replica failure is already recorded (self._failed +
+            # every future rejected) before this guard; it only shields
+            # the worker exit from a buggy USER lifecycle handler
+            # nrlint: disable=swallowed-worker-exception
+            except Exception:
+                logger.exception(
+                    "on_replica_failed handler raised; replica %d "
+                    "stays failed", rid,
+                )
+
+    def restart_replica(self, rid: int) -> None:
+        """Readmit a failed replica after repair (`fault/repair.py`):
+        fresh queue + worker; the read token is reused (registration is
+        permanent). The retired queue's counters fold into the
+        frontend-level aggregates so `stats()` stays cumulative."""
+        with self._lock:
+            if self._closed:
+                raise FrontendClosed(
+                    "cannot restart a replica on a closed frontend"
+                )
+            if rid not in self._failed:
+                raise ValueError(f"replica {rid} has not failed")
+            old = self._queues[rid].stats()
+            for k in ("accepted", "shed", "completed",
+                      "deadline_missed"):
+                self._retired[k] = self._retired.get(k, 0) + old[k]
+            q = _SubmissionQueue(self.cfg.queue_depth)
+            t = threading.Thread(
+                target=self._worker_loop, args=(rid, q),
+                name=f"serve-worker-r{rid}", daemon=True,
+            )
+            self._queues[rid] = q
+            self._workers[rid] = t
+            del self._failed[rid]
+            started = self._started
+        get_tracer().emit("serve-replica-restart", rid=rid)
+        if started:
+            t.start()
+
     def drain(self, timeout: float | None = None) -> bool:
         """Block until every queue is empty and no batch is in flight.
         Returns False on timeout. Admission stays open — this is a
@@ -391,9 +558,15 @@ class ServeFrontend:
     def submit(self, op: tuple, rid: int = 0,
                deadline_s: float | None = None) -> ServeFuture:
         """Stage one write op on replica `rid`; returns its future.
-        Raises `Overloaded` when the admission queue is full and
-        `FrontendClosed` after `close()` — both BEFORE the op can have
-        any effect."""
+        Raises `Overloaded` when the admission queue is full,
+        `FrontendClosed` after `close()`, and (failover mode)
+        `ReplicaFailed` while the replica is down — all BEFORE the op
+        can have any effect."""
+        # closed wins over failed: a closed frontend is PERMANENT and
+        # must not hand retry loops a retryable ReplicaFailed
+        if not self._closed and rid in self._failed:  # GIL-atomic reads
+            raise ReplicaFailed(rid, self._failed.get(rid),
+                                maybe_executed=False)
         q = self._queues.get(rid)
         if q is None:
             raise ValueError(f"replica {rid} is not served "
@@ -405,7 +578,18 @@ class ServeFrontend:
             else time.monotonic() + deadline_s
         )
         fut = ServeFuture(rid, deadline=deadline)
-        if not q.offer(_Request(op, fut)):
+        try:
+            admitted = q.offer(_Request(op, fut))
+        except FrontendClosed:
+            # a per-replica queue closed while the frontend is open can
+            # only mean this replica failed (or is mid-restart): that
+            # is the retryable signal, not a permanent closure
+            if not self._closed:
+                raise ReplicaFailed(
+                    rid, self._failed.get(rid), maybe_executed=False
+                ) from None
+            raise
+        if not admitted:
             self._m_shed.inc()
             get_tracer().emit("serve-shed", rid=rid,
                               depth=self.cfg.queue_depth)
@@ -432,15 +616,24 @@ class ServeFrontend:
 
     def stats(self) -> dict:
         """Aggregate + per-replica frontend counters (plain ints,
-        independent of the metrics registry's enable flag)."""
+        independent of the metrics registry's enable flag). Counters of
+        queues retired by failover restarts are folded into the
+        aggregates; `rehomed`/`failed` expose the failover state."""
         with self._lock:  # grow() can resize the dict mid-iteration
             queues = sorted(self._queues.items())
+            retired = dict(self._retired)
+            rehomed = self._rehomed
+            failed = sorted(self._failed)
         per = {rid: q.stats() for rid, q in queues}
         agg = {
             k: sum(s[k] for s in per.values())
             for k in ("queued", "in_service", "accepted", "shed",
                       "completed", "deadline_missed")
         }
+        for k, v in retired.items():
+            agg[k] += v
+        agg["rehomed"] = rehomed
+        agg["failed"] = failed
         agg["replicas"] = per
         return agg
 
@@ -455,21 +648,51 @@ class ServeFrontend:
                 return
             try:
                 self._run_batch(rid, q, batch)
+            except _ReplicaDown as down:
+                # failover: retire the replica FIRST (marks it failed,
+                # re-homes the queue, spawns the lifecycle callback),
+                # THEN complete the in-flight futures — a caller woken
+                # by its ReplicaFailed must find the failover already
+                # in motion, not a pre-failover limbo
+                self._fail_replica(rid, q, down.cause)
+                for req in down.pending:
+                    req.future._reject(ReplicaFailed(
+                        rid, down.cause,
+                        maybe_executed=down.maybe_executed,
+                    ))
+                return
             except Exception as e:  # pragma: no cover - last resort
                 logger.exception(
                     "serve worker r%d: unexpected batch failure", rid
                 )
+                if cfg.failover:
+                    self._fail_replica(rid, q, e)
                 # never strand a caller: reject whatever _run_batch
                 # had not resolved (first resolution wins, so futures
                 # it DID resolve are untouched)
                 for req in batch:
                     req.future._reject(e)
                 q.batch_done(0, 0)
+                if cfg.failover:
+                    return
 
     def _run_batch(self, rid: int, q: _SubmissionQueue,
                    batch: list[_Request]) -> None:
         """One combiner round: sweep expired deadlines, execute the
-        survivors as a single `execute_mut_batch`, resolve futures."""
+        survivors as a single `execute_mut_batch`, resolve futures.
+        In failover mode a round that throws completes its requests
+        with `ReplicaFailed` and raises `_ReplicaDown` so the loop
+        retires the replica."""
+        try:
+            # injection choke point (`fault/inject.py`): fires BEFORE
+            # any op can touch the log, so a kill here is pre-append
+            # and every in-flight request is exactly-once retryable
+            fault_hook("serve-batch", rid, self._nr)
+        except Exception as e:
+            if not self.cfg.failover:
+                raise
+            q.batch_done(0, 0)
+            raise _ReplicaDown(e, batch, maybe_executed=False) from e
         now = time.monotonic()
         live: list[_Request] = []
         missed = 0
@@ -494,6 +717,24 @@ class ServeFrontend:
                 [req.op for req in live], rid
             )
         except Exception as e:
+            if self.cfg.failover:
+                # `maybe_executed`: a failure out of the wrapper is
+                # only provably pre-append when it is the fence guard
+                # or an append-site injection (both fire before the
+                # batch reaches the log). Anything else may have struck
+                # mid-replay — the ops WILL replay, only responses are
+                # lost — so auto-retry must be refused (exactly-once).
+                pre_append = isinstance(e, ReplicaFencedError) or (
+                    isinstance(e, FaultError) and e.site == "append"
+                )
+                q.batch_done(0, missed)
+                logger.exception(
+                    "serve worker r%d: batch of %d failed; retiring "
+                    "replica", rid, len(live)
+                )
+                raise _ReplicaDown(
+                    e, live, maybe_executed=not pre_append
+                ) from e
             for req in live:
                 req.future._reject(e)
             q.batch_done(0, missed)

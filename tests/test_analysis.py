@@ -572,6 +572,127 @@ class TestSuppressionsAndSeverity:
             assert rid in out
 
 
+class TestSwallowedWorkerException:
+    def test_thread_target_pass_fires(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import threading
+
+            def worker_loop(q):
+                while True:
+                    try:
+                        q.work()
+                    except Exception:
+                        pass
+
+            t = threading.Thread(target=worker_loop, args=(None,))
+        """)
+        assert len(firing(diags, "swallowed-worker-exception")) == 1
+
+    def test_logging_only_still_fires(self, tmp_path):
+        # a log line resolves no future and quarantines no replica
+        diags = lint_src(tmp_path, """
+            import logging
+            import threading
+
+            logger = logging.getLogger(__name__)
+
+            def worker_loop(q):
+                try:
+                    q.work()
+                except Exception:
+                    logger.exception("batch failed")
+
+            t = threading.Thread(target=worker_loop, args=(None,))
+        """)
+        assert len(firing(diags, "swallowed-worker-exception")) == 1
+
+    def test_bound_method_target_and_helper_fire(self, tmp_path):
+        # self._worker_loop target; the broad except hides in a
+        # same-module helper the loop calls on the worker thread
+        diags = lint_src(tmp_path, """
+            import threading
+
+            class Frontend:
+                def start(self):
+                    t = threading.Thread(target=self._worker_loop)
+                    t.start()
+
+                def _worker_loop(self):
+                    while True:
+                        self._run_batch()
+
+                def _run_batch(self):
+                    try:
+                        self.nr.execute()
+                    except Exception:
+                        return None
+        """)
+        assert len(firing(diags, "swallowed-worker-exception")) == 1
+
+    def test_reject_sink_reraise_and_health_clean(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import threading
+
+            def rejects(batch, q):
+                try:
+                    q.work()
+                except Exception as e:
+                    for req in batch:
+                        req.future._reject(e)
+
+            def reraises(q):
+                try:
+                    q.work()
+                except Exception:
+                    raise
+
+            def reports(q, health):
+                try:
+                    q.work()
+                except Exception as e:
+                    health.report_worker_exception(0, e)
+
+            def typed_only(q):
+                try:
+                    q.work()
+                except ValueError:
+                    pass  # narrow except: not this rule's business
+
+            for fn in (rejects, reraises, reports, typed_only):
+                threading.Thread(target=fn).start()
+        """)
+        assert not firing(diags, "swallowed-worker-exception")
+
+    def test_non_thread_function_is_exempt(self, tmp_path):
+        # broad excepts outside worker threads are host-loop policy,
+        # not this rule's concern
+        diags = lint_src(tmp_path, """
+            def best_effort_cleanup(path):
+                try:
+                    remove(path)
+                except Exception:
+                    pass
+        """)
+        assert not firing(diags, "swallowed-worker-exception")
+
+    def test_suppression_works(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import threading
+
+            def worker_loop(q):
+                try:
+                    q.work()
+                # nrlint: disable=swallowed-worker-exception
+                except Exception:
+                    pass
+
+            threading.Thread(target=worker_loop).start()
+        """)
+        hits = [d for d in diags
+                if d.rule_id == "swallowed-worker-exception"]
+        assert len(hits) == 1 and hits[0].suppressed
+
+
 class TestRepoIsClean:
     def test_package_lints_clean(self):
         # the CI gate, as a test: every violation in the package is
