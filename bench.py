@@ -398,6 +398,325 @@ def chaos_main(args) -> int:
     return 0
 
 
+def crash_child_main(args) -> int:
+    """`--crash-child` (internal): the victim process of the crash
+    harness. Serves an ENDLESS sequence-verified seqreg stream with
+    durable acks (`ServeConfig(durability=...)` over an attached WAL),
+    records every fsync-acked response into `<dir>/acks.log` (one
+    flushed line per ack, written only AFTER `result()` — so an ack
+    line implies the op's WAL record is fsynced), and takes one
+    durable snapshot mid-stream. It never exits on its own: the parent
+    SIGKILLs it at a seeded ack count, exactly the preemption the
+    durability plane exists for."""
+    import os
+    import threading
+
+    from node_replication_tpu import NodeReplicated
+    from node_replication_tpu.durable import (
+        WriteAheadLog,
+        save_durable_snapshot,
+    )
+    from node_replication_tpu.models import SR_SET, make_seqreg
+    from node_replication_tpu.serve import (
+        RetryPolicy,
+        ServeConfig,
+        ServeFrontend,
+        call_with_retry,
+    )
+
+    d = args.crash_dir
+    clients = args.serve_clients
+    nr = NodeReplicated(
+        make_seqreg(clients),
+        n_replicas=max(1, args.serve_replicas),
+        log_entries=1 << 15,
+        gc_slack=512,
+        exec_window=256,
+    )
+    wal = WriteAheadLog(os.path.join(d, "wal"),
+                        policy=args.crash_durability)
+    nr.attach_wal(wal)
+    cfg = ServeConfig(
+        queue_depth=args.serve_queue_depth,
+        batch_max_ops=args.serve_batch,
+        batch_linger_s=args.serve_linger,
+        durability=args.crash_durability,
+    )
+    fe = ServeFrontend(nr, cfg)
+    rids = fe.rids
+    ack_lock = threading.Lock()
+    ack_f = open(os.path.join(d, "acks.log"), "a")
+    acked = [0]
+    retry = RetryPolicy(max_attempts=64, base_backoff_s=0.001,
+                        max_backoff_s=0.1)
+
+    def client(c: int) -> None:
+        i = 1
+        while True:
+            resp = call_with_retry(
+                fe, (SR_SET, c, i), rid=rids[c % len(rids)],
+                policy=retry,
+            )
+            with ack_lock:
+                if resp != i - 1:
+                    ack_f.write(f"ERR {c} {i} {resp}\n")
+                else:
+                    ack_f.write(f"{c} {i}\n")
+                ack_f.flush()
+                acked[0] += 1
+            i += 1
+
+    for c in range(clients):
+        threading.Thread(target=client, args=(c,),
+                         daemon=True).start()
+    # one durable snapshot mid-stream, so recovery exercises the real
+    # snapshot-base + WAL-tail split (not just replay-from-zero)
+    snap_after = args.crash_snapshot_after
+    while True:
+        time.sleep(0.02)
+        if snap_after > 0:
+            with ack_lock:
+                n = acked[0]
+            if n >= snap_after:
+                save_durable_snapshot(nr, d)
+                snap_after = 0  # once
+
+
+def crash_main(args) -> int:
+    """`--crash`: the crash-consistency gate (ISSUE 5).
+
+    Forks a child serve loop (durable-ack seqreg stream journaled into
+    a WAL), SIGKILLs it at a seeded ack count, then restarts FROM DISK
+    via `ServeFrontend.from_recovery` and verifies, with hard exits:
+
+    - **no lost ack**: every fsync-acked `(client, i)` recorded before
+      the kill is reflected in the recovered registers
+      (`value[c] >= max acked i`);
+    - **no duplicate**: the recovered WAL's per-slot history is
+      exactly `1..k`, each value once, in order — a duplicated or
+      reordered record would break the chain;
+    - **bit-identical restart**: replaying the recovered log from
+      deterministic init reproduces the recovered fleet's states
+      bit-for-bit (the paper's recovery model, now crash-tested);
+    - **serves on**: each client pushes a few more ops through the
+      recovered frontend and the fetch-and-set oracle still holds.
+    """
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from node_replication_tpu.core.checkpoint import recover_states
+    from node_replication_tpu.harness.mkbench import (
+        append_recovery_csv,
+        recovery_rows,
+    )
+    from node_replication_tpu.models import SR_GET, SR_SET, make_seqreg
+    from node_replication_tpu.serve import ServeConfig, ServeFrontend
+
+    clients = args.serve_clients
+    kill_after = args.crash_kill_after_acks
+    if kill_after <= 0:
+        import random as _random
+
+        kill_after = _random.Random(args.seed).randrange(250, 600)
+    snap_after = args.crash_snapshot_after
+    if snap_after < 0:
+        snap_after = kill_after // 2
+    d = args.crash_dir or tempfile.mkdtemp(prefix="nr-crash-")
+    os.makedirs(d, exist_ok=True)
+    acks_path = os.path.join(d, "acks.log")
+    failures: list[str] = []
+
+    child_log = open(os.path.join(d, "child.log"), "w")
+    child = subprocess.Popen(
+        [
+            sys.executable, os.path.abspath(__file__), "--crash-child",
+            "--crash-dir", d,
+            "--serve-clients", str(clients),
+            "--serve-replicas", str(args.serve_replicas),
+            "--serve-queue-depth", str(args.serve_queue_depth),
+            "--serve-batch", str(args.serve_batch),
+            "--serve-linger", str(args.serve_linger),
+            "--crash-durability", args.crash_durability,
+            "--crash-snapshot-after", str(snap_after),
+            "--seed", str(args.seed),
+        ],
+        stdout=child_log, stderr=child_log,
+    )
+
+    def ack_lines() -> list[str]:
+        try:
+            with open(acks_path) as f:
+                data = f.read()
+        except FileNotFoundError:
+            return []
+        lines = data.split("\n")
+        return [ln for ln in lines[:-1] if ln]  # drop partial tail
+
+    t_end = time.monotonic() + args.crash_timeout
+    killed = False
+    while time.monotonic() < t_end:
+        if child.poll() is not None:
+            break
+        if len(ack_lines()) >= kill_after:
+            os.kill(child.pid, signal.SIGKILL)
+            killed = True
+            break
+        time.sleep(0.02)
+    if not killed:
+        if child.poll() is None:
+            os.kill(child.pid, signal.SIGKILL)
+            failures.append(
+                f"child reached only {len(ack_lines())} acks within "
+                f"{args.crash_timeout}s (wanted {kill_after}); see "
+                f"{d}/child.log"
+            )
+        else:
+            failures.append(
+                f"child exited early (rc {child.returncode}) before "
+                f"the seeded kill; see {d}/child.log"
+            )
+    child.wait()
+    child_log.close()
+
+    # what the clients were TOLD is durable
+    acked_max = [0] * clients
+    acked_total = 0
+    for ln in ack_lines():
+        parts = ln.split()
+        if parts[0] == "ERR":
+            failures.append(f"child observed oracle violation: {ln}")
+            continue
+        c, i = int(parts[0]), int(parts[1])
+        if i != acked_max[c] + 1:
+            failures.append(
+                f"client {c} ack sequence broken at {i} "
+                f"(after {acked_max[c]})"
+            )
+        acked_max[c] = max(acked_max[c], i)
+        acked_total += 1
+
+    # restart from disk through the serve-layer recovery entry
+    dispatch = make_seqreg(clients)
+    cfg = ServeConfig(
+        queue_depth=args.serve_queue_depth,
+        batch_max_ops=args.serve_batch,
+        batch_linger_s=args.serve_linger,
+        durability=args.crash_durability,
+    )
+    fe = ServeFrontend.from_recovery(d, dispatch, cfg)
+    report = fe.recovery_report
+    nr = fe.nr
+
+    lost = 0
+    values = []
+    for c in range(clients):
+        v = fe.read((SR_GET, c), rid=0)
+        values.append(v)
+        if v < acked_max[c]:
+            lost += acked_max[c] - v
+            failures.append(
+                f"client {c}: fsync-acked up to {acked_max[c]} but "
+                f"recovered register holds {v} (LOST ACKED WRITES)"
+            )
+
+    # duplicate/reorder scan over the recovered WAL's full history
+    # (single segment at this run size, so position 0 is still there)
+    duplicated = 0
+    seen_next = [1] * clients
+    for rec in nr.wal.records(0):
+        for opc, row in zip(rec.opcodes, rec.args):
+            c, v = int(row[0]) % clients, int(row[1])
+            if v < seen_next[c]:
+                duplicated += 1
+                failures.append(
+                    f"client {c}: WAL holds value {v} again after "
+                    f"reaching {seen_next[c] - 1} (DUPLICATED OP)"
+                )
+            elif v > seen_next[c]:
+                failures.append(
+                    f"client {c}: WAL skips from {seen_next[c] - 1} "
+                    f"to {v} (hole in journaled history)"
+                )
+                seen_next[c] = v + 1
+            else:
+                seen_next[c] += 1
+
+    # bit-identity: the recovered fleet must equal a from-init replay
+    # of the recovered log (the acceptance criterion's third clause)
+    import jax
+
+    _, replay_states = recover_states(dispatch, nr.spec, nr.log)
+    for a, b in zip(jax.tree.leaves(nr.states),
+                    jax.tree.leaves(replay_states)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            failures.append(
+                "recovered states are NOT bit-identical to replaying "
+                "the recovered log from init"
+            )
+            break
+
+    # the recovered frontend must serve on: continue each sequence
+    post_ops = 0
+    with fe:
+        for c in range(clients):
+            for i in range(values[c] + 1, values[c] + 4):
+                resp = fe.call((SR_SET, c, i),
+                               rid=fe.rids[c % len(fe.rids)])
+                if resp != i - 1:
+                    failures.append(
+                        f"post-restart client {c} op {i}: expected "
+                        f"{i - 1}, got {resp}"
+                    )
+                post_ops += 1
+
+    append_recovery_csv(args.serve_out, recovery_rows(
+        "bench", report, clients=clients,
+        durability=args.crash_durability, acked=acked_total,
+        kill_after=kill_after, lost=lost, duplicated=duplicated,
+        post_restart_ops=post_ops,
+    ))
+    print(json.dumps({
+        "metric": "crash_recovery_durable_acks",
+        "value": lost + duplicated,
+        "unit": "lost_or_duplicated_acked_ops",
+        "clients": clients,
+        "durability": args.crash_durability,
+        "acked_before_kill": acked_total,
+        "kill_after_acks": kill_after,
+        "snapshot_pos": report.snapshot_pos,
+        "wal_records": report.wal_records,
+        "wal_ops_replayed": report.wal_ops,
+        "wal_truncated_bytes": report.wal_truncated_bytes,
+        "recovery_s": round(report.duration_s, 4),
+        "tail": report.tail,
+        "lost": lost,
+        "duplicated": duplicated,
+        "post_restart_ops": post_ops,
+        "bit_identical": not any("bit-identical" in f
+                                 for f in failures),
+    }))
+    if not args.crash_dir:
+        shutil.rmtree(d, ignore_errors=True)
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# crash OK: SIGKILL after {acked_total} fsync-acked ops; "
+        f"recovery (snapshot@{report.snapshot_pos} + "
+        f"{report.wal_ops} WAL ops, {report.duration_s * 1e3:.0f}ms) "
+        f"lost 0, duplicated 0, bit-identical restart, served "
+        f"{post_ops} more ops",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--replicas", type=int, default=4096)
@@ -480,11 +799,45 @@ def main():
                        help="minimum completed/attempted ratio (the "
                             "pre-append failover design target is "
                             "1.0: kills cost latency, not responses)")
+    crash = p.add_argument_group(
+        "crash", "crash-consistency benchmark (--crash): fork a "
+                 "durable-ack serve loop, SIGKILL it at a seeded ack "
+                 "count, restart from disk (snapshot + WAL replay) "
+                 "and exit 1 if any fsync-acked response is lost or "
+                 "duplicated, or the restart is not bit-identical")
+    crash.add_argument("--crash", action="store_true",
+                       help="run the crash-recovery benchmark (reuses "
+                            "the --serve-* knobs for load shape)")
+    crash.add_argument("--crash-child", action="store_true",
+                       help=argparse.SUPPRESS)  # internal: the victim
+    crash.add_argument("--crash-dir", default=None,
+                       help="durability directory (default: a temp "
+                            "dir, removed after a clean run)")
+    crash.add_argument("--crash-kill-after-acks", type=int, default=0,
+                       help="SIGKILL the child once this many acks "
+                            "are on disk (0 = seeded from --seed)")
+    crash.add_argument("--crash-snapshot-after", type=int, default=-1,
+                       help="child takes one durable snapshot after "
+                            "this many acks (-1 = half the kill "
+                            "point, 0 = never)")
+    crash.add_argument("--crash-durability",
+                       choices=["batch", "always"], default="batch",
+                       help="durable-ack mode under test (WAL fsync "
+                            "per batch vs per append)")
+    crash.add_argument("--crash-timeout", type=float, default=90.0,
+                       help="parent gives up waiting for the kill "
+                            "point after this many seconds")
     args = p.parse_args()
     if args.max_attempts < 1:
         p.error("--max-attempts must be >= 1")
-    if args.chaos and args.serve:
-        p.error("--chaos and --serve are mutually exclusive")
+    if sum(map(bool, (args.chaos, args.serve, args.crash))) > 1:
+        p.error("--chaos, --serve and --crash are mutually exclusive")
+    if args.crash_child:
+        if not args.crash_dir:
+            p.error("--crash-child requires --crash-dir")
+        sys.exit(crash_child_main(args))
+    if args.crash:
+        sys.exit(crash_main(args))
     if args.chaos:
         sys.exit(chaos_main(args))
     if args.serve:

@@ -58,6 +58,11 @@ from node_replication_tpu.core.replica import (  # noqa: E402
     ReplicaToken,
 )
 from node_replication_tpu.core.step import make_step  # noqa: E402
+from node_replication_tpu.durable import (  # noqa: E402
+    WriteAheadLog,
+    recover_fleet,
+    save_durable_snapshot,
+)
 from node_replication_tpu.fault import (  # noqa: E402
     FaultPlan,
     FaultSpec,
@@ -104,6 +109,9 @@ __all__ = [
     "ReplicaLifecycleManager",
     "ServeConfig",
     "ServeFrontend",
+    "WriteAheadLog",
+    "recover_fleet",
+    "save_durable_snapshot",
 ]
 
 __version__ = "0.1.0"

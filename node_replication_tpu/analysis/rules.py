@@ -1019,3 +1019,126 @@ def time_in_traced(mod: ModuleInfo,
             f"time and frozen into the program; time on the host side "
             f"of the dispatch",
         )
+
+
+# --------------------------------------------------------------------------
+# non-durable-publish
+# --------------------------------------------------------------------------
+
+_PUBLISH_FNS = ("os.replace", "os.rename")
+_SAVEZ_FNS = ("numpy.savez", "numpy.savez_compressed")
+
+
+def _binary_write_mode(call: ast.Call) -> bool:
+    """`open(...)` whose mode constant creates/truncates a BINARY file
+    (`wb`, `xb`, `w+b`, ...) — the write half of a publish sequence."""
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return (
+        isinstance(mode, str)
+        and "b" in mode
+        and any(c in mode for c in "wx")
+    )
+
+
+def _walk_scope(node: ast.AST, _root: bool = True):
+    """Walk a scope WITHOUT descending into nested function scopes
+    (each function is analyzed as its own publish sequence)."""
+    if not _root and isinstance(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    ):
+        return
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_scope(child, _root=False)
+
+
+@rule(
+    "non-durable-publish", WARNING,
+    "atomic-rename publish of a written file with no fsync between",
+)
+def non_durable_publish(mod: ModuleInfo,
+                        project: Project) -> Iterator[Diagnostic]:
+    """The durable-publish convention (`core/checkpoint.py:
+    save_snapshot`, `durable/wal.py`): a file published by atomic
+    rename must be fsynced FIRST — `os.replace` orders the directory
+    entry, not the data blocks, so a crash between rename and
+    writeback publishes a name that points at a torn or empty file
+    (exactly the published-but-empty snapshot failure recovery cannot
+    distinguish from corruption). Flags, per function scope:
+
+    - a binary-create `open(..., "wb"/"xb"/...)` followed by
+      `os.replace`/`os.rename` with no `os.fsync` between them;
+    - a bare `np.savez`/`np.savez_compressed` straight to a path
+      (anything but a handle bound from `open()` in the same scope):
+      writing the final name directly has no atomic publish at all —
+      write to an fsynced tmp file and rename it in.
+
+    Text-mode rewrites (CSV upgrades) and append-only handles are out
+    of scope: they are not publish points for recovery-critical state.
+    """
+    scopes = [mod.tree] + [
+        n for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        open_lines: list[int] = []
+        open_bound: set[str] = set()
+        fsync_lines: list[int] = []
+        publishes: list[ast.Call] = []
+        savez_calls: list[tuple[ast.Call, str]] = []
+        for node in _walk_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (isinstance(fn, ast.Name) and fn.id == "open"
+                    and _binary_write_mode(node)):
+                open_lines.append(node.lineno)
+                parent = mod.parent(node)
+                if (isinstance(parent, ast.withitem)
+                        and isinstance(parent.optional_vars,
+                                       ast.Name)):
+                    open_bound.add(parent.optional_vars.id)
+                elif (isinstance(parent, ast.Assign)
+                      and len(parent.targets) == 1
+                      and isinstance(parent.targets[0], ast.Name)):
+                    open_bound.add(parent.targets[0].id)
+            dotted = mod.dotted(fn)
+            if dotted == "os.fsync":
+                fsync_lines.append(node.lineno)
+            elif dotted in _PUBLISH_FNS:
+                publishes.append(node)
+            elif dotted in _SAVEZ_FNS:
+                savez_calls.append((node, dotted.split(".")[-1]))
+        for node in publishes:
+            prior = [lo for lo in open_lines if lo < node.lineno]
+            if not prior:
+                continue
+            lo = max(prior)
+            if any(lo <= lf < node.lineno for lf in fsync_lines):
+                continue
+            yield _diag(
+                mod, node, "non-durable-publish",
+                "os.replace/os.rename publishes a file written at "
+                f"line {lo} with no os.fsync between write and "
+                "rename; a crash can publish a torn/empty file — "
+                "fsync the handle before renaming (and the directory "
+                "after, for the entry itself)",
+            )
+        for node, name in savez_calls:
+            first = node.args[0] if node.args else None
+            if first is None or (
+                isinstance(first, ast.Name) and first.id in open_bound
+            ):
+                continue
+            yield _diag(
+                mod, node, "non-durable-publish",
+                f"np.{name} writes directly to its final path (no "
+                "atomic publish, no fsync): write into an open tmp-"
+                "file handle, fsync it, then os.replace into place "
+                "(core/checkpoint.py:save_snapshot is the template)",
+            )

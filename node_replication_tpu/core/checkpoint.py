@@ -18,12 +18,26 @@ for the TPU build, where jobs are preempted routinely:
   live replay. Determinism of `Dispatch` transitions makes the result
   bit-identical to the lost states.
 
-The RUNTIME consumer of this recovery model is `fault/`
-(`fault/repair.py`): a quarantined replica is rebuilt live — donor
-snapshot at the donor's ltail, then replay to tail — turning
-recover-by-replay from an offline utility into the repair half of the
-detect/quarantine/repair lifecycle (serve failover rides it through
-`ReplicaLifecycleManager`).
+The RUNTIME consumers of this recovery model:
+
+- `fault/` (`fault/repair.py`): a quarantined replica is rebuilt live —
+  donor snapshot at the donor's ltail, then replay to tail — turning
+  recover-by-replay from an offline utility into the repair half of the
+  detect/quarantine/repair lifecycle (serve failover rides it through
+  `ReplicaLifecycleManager`).
+- `durable/` (`durable/recovery.py`): the CRASH-time consumer — on
+  process restart the newest valid snapshot loaded here is the base,
+  and the write-ahead log (`durable/wal.py`) supplies the tail
+  `[snapshot_pos, durable_tail)` that replays through the same
+  dispatch scan, making a kill -9 or preemption restart bit-identical.
+
+Durability discipline: `save_snapshot` fsyncs the tmp file before the
+atomic `os.replace` and fsyncs the parent directory after it (a crash
+can never leave a published-but-empty snapshot), and every payload is
+sealed with a blake2b manifest digest that `load_snapshot` verifies —
+truncation, bit rot, or missing fields raise the typed
+`SnapshotCorruptError` so recovery can fall back to an older snapshot
+instead of folding garbage into a fleet.
 """
 
 from __future__ import annotations
@@ -51,6 +65,39 @@ from node_replication_tpu.utils.trace import span
 PyTree = Any
 
 _SPEC_FIELDS = ("capacity", "n_replicas", "arg_width", "gc_slack")
+
+# Manifest key holding the payload digest; never part of the digest.
+_DIGEST_KEY = "manifest_digest"
+
+
+class SnapshotCorruptError(RuntimeError):
+    """The snapshot failed integrity validation (digest mismatch,
+    truncated archive, missing fields). Typed so recovery
+    (`durable/recovery.py`) can fall back to an older snapshot instead
+    of crashing on a bare numpy/zipfile error."""
+
+    def __init__(self, path: str, detail: str):
+        super().__init__(f"corrupt snapshot {path}: {detail}")
+        self.path = path
+        self.detail = detail
+
+
+def _payload_digest(payload: dict) -> np.ndarray:
+    """blake2b over every payload entry (key + dtype + shape + bytes,
+    key-sorted) — order-independent of dict construction, sensitive to
+    any bit of any array."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=32)
+    for key in sorted(payload):
+        if key == _DIGEST_KEY:
+            continue
+        arr = np.ascontiguousarray(np.asarray(payload[key]))
+        h.update(key.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(repr(arr.shape).encode())
+        h.update(arr.tobytes())
+    return np.frombuffer(h.digest(), np.uint8).copy()
 
 
 def save_snapshot(path: str, spec: LogSpec, log: LogState,
@@ -80,42 +127,122 @@ def save_snapshot(path: str, spec: LogSpec, log: LogState,
         }
         for i, leaf in enumerate(leaves):
             payload[f"state_{i}"] = np.asarray(leaf)
+        payload[_DIGEST_KEY] = _payload_digest(payload)
         tmp = f"{path}.{os.getpid()}.tmp"
+        # publish durably: fsync the payload BEFORE the atomic rename
+        # and the directory entry AFTER it — otherwise a crash between
+        # replace and writeback publishes a name pointing at nothing
+        # (machine-checked by nrlint `non-durable-publish`)
         with open(tmp, "wb") as f:
             np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        dfd = os.open(
+            os.path.dirname(os.path.abspath(path)), os.O_RDONLY
+        )
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
     get_registry().histogram("checkpoint.save_s").observe(
         time.perf_counter() - t0
     )
 
 
+def _open_snapshot(path: str):
+    """np.load with zip/format failures mapped to the typed error."""
+    import zipfile
+
+    try:
+        return np.load(path)
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, OSError, ValueError, EOFError) as e:
+        raise SnapshotCorruptError(
+            path, f"unreadable archive ({type(e).__name__}: {e})"
+        ) from e
+
+
 def peek_spec(path: str) -> LogSpec:
     """Read only the LogSpec from a snapshot (owns the `_SPEC_FIELDS`
-    encoding, so callers never index the raw array)."""
-    with np.load(path) as z:
-        return LogSpec(
-            **dict(zip(_SPEC_FIELDS, (int(v) for v in z["spec"])))
-        )
+    encoding, so callers never index the raw array). Raises
+    `SnapshotCorruptError` on truncation or missing manifest fields."""
+    with _open_snapshot(path) as z:
+        try:
+            if _DIGEST_KEY not in z.files:
+                raise SnapshotCorruptError(
+                    path, "missing manifest digest"
+                )
+            spec_row = z["spec"]
+            if spec_row.shape != (len(_SPEC_FIELDS),):
+                raise SnapshotCorruptError(
+                    path, f"spec field has shape {spec_row.shape}"
+                )
+            return LogSpec(
+                **dict(zip(_SPEC_FIELDS, (int(v) for v in spec_row)))
+            )
+        except KeyError as e:
+            raise SnapshotCorruptError(
+                path, f"missing field {e.args[0]!r}"
+            ) from e
 
 
 def load_snapshot(path: str, states_template: PyTree
                   ) -> tuple[LogSpec, LogState, PyTree]:
     """Load a snapshot; `states_template` supplies the pytree structure
-    (e.g. `replicate_state(d.init_state(), R)`)."""
+    (e.g. `replicate_state(d.init_state(), R)`). The payload's blake2b
+    manifest digest is recomputed and verified — mismatch, truncation,
+    or missing fields raise `SnapshotCorruptError`."""
     t0 = time.perf_counter()
-    with span("checkpoint-load", path=path), np.load(path) as z:
+    import zipfile
+
+    with span("checkpoint-load", path=path), _open_snapshot(path) as z:
+        try:
+            # np.load is lazy: per-entry reads are where a truncated
+            # or bit-flipped archive actually surfaces
+            payload = {k: z[k] for k in z.files}
+        except (KeyError, ValueError, OSError, EOFError,
+                zipfile.BadZipFile) as e:
+            raise SnapshotCorruptError(
+                path, f"truncated payload ({type(e).__name__}: {e})"
+            ) from e
+        if _DIGEST_KEY not in payload:
+            raise SnapshotCorruptError(path, "missing manifest digest")
+        want = payload[_DIGEST_KEY]
+        got = _payload_digest(payload)
+        if not np.array_equal(want, got):
+            raise SnapshotCorruptError(
+                path, "manifest digest mismatch (payload corrupted)"
+            )
+        missing = [
+            k for k in ("spec", "log_opcodes", "log_args", "log_head",
+                        "log_tail", "log_ctail", "log_ltails",
+                        "n_state_leaves")
+            if k not in payload
+        ]
+        if missing:
+            raise SnapshotCorruptError(
+                path, f"missing fields {missing}"
+            )
         spec = LogSpec(**dict(zip(_SPEC_FIELDS,
-                                  (int(v) for v in z["spec"]))))
+                                  (int(v) for v in payload["spec"]))))
         log = LogState(
-            opcodes=jnp.asarray(z["log_opcodes"]),
-            args=jnp.asarray(z["log_args"]),
-            head=jnp.asarray(z["log_head"]),
-            tail=jnp.asarray(z["log_tail"]),
-            ctail=jnp.asarray(z["log_ctail"]),
-            ltails=jnp.asarray(z["log_ltails"]),
+            opcodes=jnp.asarray(payload["log_opcodes"]),
+            args=jnp.asarray(payload["log_args"]),
+            head=jnp.asarray(payload["log_head"]),
+            tail=jnp.asarray(payload["log_tail"]),
+            ctail=jnp.asarray(payload["log_ctail"]),
+            ltails=jnp.asarray(payload["log_ltails"]),
         )
-        n = int(z["n_state_leaves"])
-        leaves = [jnp.asarray(z[f"state_{i}"]) for i in range(n)]
+        n = int(payload["n_state_leaves"])
+        try:
+            leaves = [jnp.asarray(payload[f"state_{i}"])
+                      for i in range(n)]
+        except KeyError as e:
+            raise SnapshotCorruptError(
+                path, f"missing state leaf {e.args[0]!r}"
+            ) from e
     get_registry().histogram("checkpoint.load_s").observe(
         time.perf_counter() - t0
     )

@@ -583,6 +583,44 @@ def _catchup_union_plan(
     return log, states, resps
 
 
+def ring_slice(
+    spec: LogSpec, log: LogState, start: int, stop: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side readback of ring entries `[start, stop)` as numpy
+    `(opcodes int32[n], args int32[n, A])`.
+
+    The durability plane's bridge out of device memory
+    (`durable/wal.py`): `NodeReplicated.attach_wal(backfill=True)`
+    persists entries that were appended BEFORE the WAL attached, and
+    they are only readable while the ring still physically holds them —
+    `start >= tail - capacity` (a wrapped slot has been overwritten; a
+    WAL attached that late needs a snapshot instead). The durable-tail
+    cursor itself lives host-side on the WAL (`WriteAheadLog.
+    durable_tail`), not in `LogState`: fsync progress is host truth and
+    must never enter the compiled step. Positions at/past `tail` raise
+    — they are not live entries.
+    """
+    start, stop = int(start), int(stop)
+    tail = int(log.tail)
+    if stop > tail:
+        raise ValueError(
+            f"ring_slice [{start}, {stop}) runs past tail {tail}"
+        )
+    if start < tail - spec.capacity:
+        raise ValueError(
+            f"ring_slice [{start}, {stop}) starts below "
+            f"tail - capacity = {tail - spec.capacity}: entries "
+            f"already overwritten by ring wrap"
+        )
+    if stop < start:
+        raise ValueError(f"ring_slice [{start}, {stop}) is negative")
+    idx = (np.arange(start, stop, dtype=np.int64)
+           & spec.mask).astype(np.int32)
+    opcodes = np.asarray(log.opcodes)[idx]
+    args = np.asarray(log.args)[idx]
+    return opcodes, args
+
+
 def is_replica_synced_for_reads(
     log: LogState, ridx: int, ctail: jax.Array
 ) -> jax.Array:

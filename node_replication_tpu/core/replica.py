@@ -54,6 +54,7 @@ from node_replication_tpu.core.log import (
     log_exec_all,
     log_init,
     log_space,
+    ring_slice,
 )
 from node_replication_tpu.fault.inject import fault_hook
 from node_replication_tpu.obs.metrics import COUNT_BUCKETS, get_registry
@@ -254,6 +255,11 @@ class NodeReplicated:
         # (the compiled programs never see a mask argument); a bool[R]
         # numpy array while any replica is fenced.
         self._fenced: np.ndarray | None = None
+        # Write-ahead log (`durable/wal.py`): None (the default) costs
+        # one branch per append/exec round, the obs/metrics discipline.
+        # While attached, every combiner append is mirrored into it
+        # and GC-head progress drives segment reclamation.
+        self._wal = None
         self._exec_rounds = 0
         # Rounds short-circuited because every replica was already at the
         # tail (empty combine() help, read-sync polling) — the device
@@ -565,6 +571,72 @@ class NodeReplicated:
         )
         return donor, donor_ltail
 
+    # ------------------------------------------------- durability (durable/)
+
+    @property
+    def wal(self):
+        """The attached write-ahead log (None when not durable)."""
+        return self._wal
+
+    @_locked
+    def attach_wal(self, wal, backfill: bool = True) -> None:
+        """Attach a `durable/wal.py:WriteAheadLog`: every subsequent
+        combiner append is persisted into it (fsync per its policy),
+        and the exec loop drives segment reclamation from GC-head
+        progress.
+
+        `backfill=True` (default) persists entries the log already
+        holds past the WAL's tail — `[wal.tail, tail)` read back from
+        the ring (`core/log.py:ring_slice`) — so a WAL can attach to a
+        live, mid-traffic instance. That is only possible while the
+        ring still physically holds those entries; attaching later
+        than `capacity` appends needs a snapshot-based recovery
+        (`durable/recovery.py`) instead. A WAL ahead of the log is
+        refused: its unreplayed tail must go through recovery first.
+        """
+        if self._wal is not None:
+            raise RuntimeError("a WAL is already attached")
+        tail = int(self.log.tail)
+        wal_tail = wal.tail
+        if wal_tail > tail:
+            raise ValueError(
+                f"WAL tail {wal_tail} is ahead of the log tail {tail}; "
+                f"recover the WAL into the fleet first "
+                f"(durable/recovery.py)"
+            )
+        if wal_tail < tail:
+            if not backfill:
+                raise ValueError(
+                    f"WAL tail {wal_tail} is behind the log tail "
+                    f"{tail} and backfill=False"
+                )
+            opcodes, args = ring_slice(self.spec, self.log,
+                                       wal_tail, tail)
+            wal.append(wal_tail, [
+                (int(opcodes[i]), *(int(a) for a in args[i]))
+                for i in range(opcodes.shape[0])
+            ])
+        self._wal = wal
+        get_tracer().emit("wal-attach", tail=tail,
+                          backfilled=tail - wal_tail)
+
+    @_locked
+    def detach_wal(self):
+        """Detach and return the WAL (not closed — the caller owns its
+        lifecycle)."""
+        wal, self._wal = self._wal, None
+        return wal
+
+    def wal_sync(self) -> int:
+        """fsync the attached WAL (`WriteAheadLog.sync`) — the serve
+        frontend's durable-ack barrier. Deliberately NOT under the
+        combiner lock: fsync latency must not stall concurrent
+        combiner rounds; the WAL has its own lock."""
+        wal = self._wal
+        if wal is None:
+            raise RuntimeError("no WAL attached (attach_wal)")
+        return wal.sync()
+
     @_locked
     def execute_mut(self, op: tuple, token: ReplicaToken):
         """Stage one write op, combine, and return its response
@@ -686,6 +758,16 @@ class NodeReplicated:
         with span("append", rid=rid, n=n, pos0=pos0, **extra) as sp:
             self.log = self._append_call(opcodes, args, n)
             sp.fence(self.log)
+        if self._wal is not None:
+            # WAL write AFTER the device append, under the same lock:
+            # a WAL record exists only for ops that ARE in the
+            # in-memory log, so the two never disagree about history.
+            # A WAL failure here raises out of the round after the ops
+            # are appended — the post-append failure class the serve
+            # layer already treats as maybe_executed (not retryable);
+            # with fsync policy `always` the records are durable
+            # before any response is delivered.
+            self._wal.append(pos0, ops)
         inflight = self._inflight[rid]
         for j, tid in enumerate(tids):
             inflight.append((pos0 + j, tid))
@@ -989,6 +1071,12 @@ class NodeReplicated:
         # worst remaining lag after this round (tail is fixed across the
         # round: replay never appends); one observe, values already host
         self._m_lag.observe(tail - int(ltails_after.min()))
+        if self._wal is not None:
+            # GC/head coupling (`durable/wal.py`): min(ltails) is the
+            # head this round just computed (<= head under fencing —
+            # an under-estimate only ever under-reclaims); O(1) when
+            # no whole segment has fallen below the floor
+            self._wal.maybe_reclaim(int(ltails_after.min()))
         resps_np = np.asarray(resps)
         for r in range(self.n_replicas):
             q = self._inflight[r]

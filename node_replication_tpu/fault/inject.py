@@ -35,6 +35,10 @@ Actions:
 - ``corrupt`` — perturb one replica's slice of the owner's state pytree
   (`corrupt_states`), giving divergence detection
   (`fault/health.py:divergence_vote`) something real to catch.
+- ``corrupt-bytes`` — flip one byte of the owner's last on-disk WAL
+  record (`durable/wal.py:_corrupt_tail_bytes`), giving the CRC
+  validation on reopen something real to catch. Ignored at owners
+  without that hook.
 """
 
 from __future__ import annotations
@@ -47,9 +51,12 @@ import time
 from node_replication_tpu.obs.metrics import get_registry
 from node_replication_tpu.utils.trace import get_tracer
 
-# Every armable site, in hook order of the write path.
-SITES = ("replay", "append", "read-sync", "serve-batch")
-ACTIONS = ("raise", "stall", "corrupt")
+# Every armable site, in hook order of the write path; the `wal-*`
+# sites are the durability plane's choke points (`durable/wal.py`:
+# segment open/scan, record append, fsync barrier).
+SITES = ("replay", "append", "read-sync", "serve-batch",
+         "wal-append", "wal-fsync", "wal-open")
+ACTIONS = ("raise", "stall", "corrupt", "corrupt-bytes")
 
 # Upper bound on an injected stall: stalls must stay bounded so a
 # chaos run can never wedge — long enough for the watchdog/health
@@ -235,6 +242,13 @@ class FaultPlan:
             raise FaultError(site, target)
         if spec.action == "stall":
             time.sleep(spec.effective_stall_s)
+            return
+        if spec.action == "corrupt-bytes":
+            # flip a byte of the owner's last on-disk record (the
+            # owner is the WAL whose operation hit the hook)
+            if owner is not None and hasattr(owner,
+                                             "_corrupt_tail_bytes"):
+                owner._corrupt_tail_bytes()
             return
         # corrupt: perturb the owner's state pytree in place (the owner
         # is the wrapper whose host loop hit the hook)

@@ -58,6 +58,17 @@ _SKEW_FIELDS = [
 BASELINE_CSV = "baseline_comparison.csv"
 SERVE_CSV = "serve_benchmarks.csv"
 CHAOS_CSV = "chaos_benchmarks.csv"
+RECOVERY_CSV = "recovery_benchmarks.csv"
+# One row per crash-recovery measurement (`bench.py --crash`): what
+# the seeded SIGKILL destroyed vs. what recovery restored — fsync-acked
+# ops before the kill, the snapshot/WAL split the restart replayed
+# from, restore latency, and the two hard gates (lost/duplicated
+# fsync-acked responses, both must be 0).
+_RECOVERY_FIELDS = [
+    "name", "clients", "durability", "acked", "kill_after_acks",
+    "snapshot_pos", "wal_records", "wal_ops", "truncated_bytes",
+    "recovery_s", "tail", "lost", "duplicated", "post_restart_ops",
+]
 # One row per chaos measurement (`bench.py --chaos`): availability
 # (completed/attempts), re-homed request count, and repair-latency
 # percentiles next to the usual serve latency columns. `kills` is how
@@ -922,6 +933,35 @@ def chaos_rows(name: str, res: ChaosResult) -> list[dict]:
 
 def append_chaos_csv(out_dir: str, rows: list[dict]) -> None:
     _append_csv(os.path.join(out_dir, CHAOS_CSV), _CHAOS_FIELDS, rows)
+
+
+def recovery_rows(name: str, report, *, clients: int, durability: str,
+                  acked: int, kill_after: int, lost: int,
+                  duplicated: int, post_restart_ops: int) -> list[dict]:
+    """The RECOVERY_CSV row for one crash-recovery measurement
+    (`report` is a `durable/recovery.py:RecoveryReport`; the kwargs
+    carry what the crash harness observed around it)."""
+    return [{
+        "name": f"{name}/crash-seqreg",
+        "clients": clients,
+        "durability": durability,
+        "acked": acked,
+        "kill_after_acks": kill_after,
+        "snapshot_pos": report.snapshot_pos,
+        "wal_records": report.wal_records,
+        "wal_ops": report.wal_ops,
+        "truncated_bytes": report.wal_truncated_bytes,
+        "recovery_s": round(report.duration_s, 4),
+        "tail": report.tail,
+        "lost": lost,
+        "duplicated": duplicated,
+        "post_restart_ops": post_restart_ops,
+    }]
+
+
+def append_recovery_csv(out_dir: str, rows: list[dict]) -> None:
+    _append_csv(os.path.join(out_dir, RECOVERY_CSV),
+                _RECOVERY_FIELDS, rows)
 
 
 def measure_native(

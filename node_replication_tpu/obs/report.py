@@ -27,6 +27,12 @@ Sections:
   repair-duration histogram (power-of-two millisecond buckets) with
   p50/p95, and the counts the chaos gates watch: injected faults,
   quarantines, completed repairs, re-homed requests.
+- **durability** (when the trace has `wal-*` / `recovery*` /
+  `durable-snapshot` events, `durable/`) — fsync count and latency
+  p50/p95/p99 (`wal-sync` spans), torn-tail truncations, segment
+  reclamations, snapshots taken, and the recovery timeline: every
+  durability-plane event in order with its `t+` offset, so a
+  crash-restart reads as a story (open → truncate → replay → attach).
 
 Pure stdlib on purpose: on a machine without jax, copy this file next
 to the trace and run it directly (`python report.py trace.jsonl`) —
@@ -218,6 +224,49 @@ def analyze(events: list[dict]) -> dict:
             },
         }
 
+    # durability section: fsync shape + the recovery timeline from
+    # wal-*/recovery*/durable-snapshot events (durable/)
+    durability = None
+    _DUR_EVENTS = ("wal-open", "wal-truncate", "wal-sync", "wal-attach",
+                   "wal-reclaim", "durable-snapshot", "recovery",
+                   "recovery-done")
+    dur_evts = [e for e in events if e.get("event") in _DUR_EVENTS]
+    if dur_evts:
+        syncs = sorted(float(e.get("duration_s", 0.0))
+                       for e in dur_evts
+                       if e.get("event") == "wal-sync")
+        timeline_d = []
+        for e in sorted(dur_evts,
+                        key=lambda e: _event_time(e, mono0, ts0)):
+            name = e["event"]
+            if name == "wal-sync":
+                continue  # histogrammed, not narrated (too many)
+            detail = {k: v for k, v in e.items()
+                      if k not in ("event", "ts", "mono", "tid")}
+            timeline_d.append({
+                "t": round(_event_time(e, mono0, ts0), 3),
+                "event": name,
+                **detail,
+            })
+        recs = [e for e in dur_evts if e.get("event") == "recovery-done"]
+        durability = {
+            "fsyncs": len(syncs),
+            "fsync_p50_s": _percentile(syncs, 0.50),
+            "fsync_p95_s": _percentile(syncs, 0.95),
+            "fsync_p99_s": _percentile(syncs, 0.99),
+            "truncations": sum(1 for e in dur_evts
+                               if e.get("event") == "wal-truncate"),
+            "reclaimed_segments": sum(
+                int(e.get("deleted", 0)) for e in dur_evts
+                if e.get("event") == "wal-reclaim"
+            ),
+            "snapshots": sum(1 for e in dur_evts
+                             if e.get("event") == "durable-snapshot"),
+            "recoveries": len(recs),
+            "replayed_ops": sum(int(e.get("ops", 0)) for e in recs),
+            "timeline": timeline_d,
+        }
+
     return {
         "n_events": len(events),
         "event_counts": dict(counts),
@@ -228,6 +277,7 @@ def analyze(events: list[dict]) -> dict:
         },
         "serve": serve,
         "fault": fault,
+        "durability": durability,
         "stalls": [
             {"where": where, "log": log, **{k: (sorted(v)
                                                if isinstance(v, set)
@@ -332,6 +382,29 @@ def render(report: dict, out=None) -> None:
                     f"{to}@t+{t}s" for t, _frm, to in tl[rid]
                 )
                 w(f"    r{rid}: {steps}\n")
+
+    dur = report.get("durability")
+    if dur:
+        w("\n== durability ==\n")
+        w(f"  fsyncs: {dur['fsyncs']}"
+          + (f" (p50 {_fmt_s(dur['fsync_p50_s'])} "
+             f"p95 {_fmt_s(dur['fsync_p95_s'])} "
+             f"p99 {_fmt_s(dur['fsync_p99_s'])})"
+             if dur["fsyncs"] else "")
+          + f"   torn-tail truncations: {dur['truncations']}   "
+            f"reclaimed segments: {dur['reclaimed_segments']}\n")
+        w(f"  snapshots: {dur['snapshots']}   "
+          f"recoveries: {dur['recoveries']}"
+          + (f" ({dur['replayed_ops']} op(s) replayed from WAL)"
+             if dur["recoveries"] else "") + "\n")
+        if dur["timeline"]:
+            w("  timeline:\n")
+            for e in dur["timeline"]:
+                detail = " ".join(
+                    f"{k}={v}" for k, v in e.items()
+                    if k not in ("t", "event")
+                )
+                w(f"    t+{e['t']:>8.3f}s {e['event']:<17} {detail}\n")
 
     w("\n== stall report ==\n")
     if not report["stalls"]:

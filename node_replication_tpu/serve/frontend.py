@@ -36,6 +36,14 @@ Production edges, each with a typed signal (`serve/errors.py`):
   `restart_replica` readmits the repaired replica with a fresh queue
   and worker. Off (default), a failed batch rejects its own futures
   and the worker keeps serving — the pre-fault behavior.
+- **durable acks** (`ServeConfig(durability="batch"|"always")`, the
+  `durable/` integration) — a batch's futures resolve only after its
+  WAL records are fsynced (one fsync per batch in `"batch"` mode —
+  group commit riding the existing batching; per-append in
+  `"always"`), so a response a client has seen survives kill -9.
+  `ServeFrontend.from_recovery(dir, dispatch, ...)` reopens
+  mid-traffic state after a crash: newest valid snapshot + WAL-tail
+  replay, bit-identical, WAL re-attached, serving resumed.
 
 Reads bypass the write queue entirely: `read()` dispatches against the
 caller's replica through the wrapper's read-sync path (`execute`),
@@ -94,6 +102,18 @@ class ServeConfig:
       `ReplicaFailed` to in-flight callers, queued requests re-homed,
       `on_replica_failed` lifecycle callback) instead of rejecting the
       batch and limping on. See the module docstring and `fault/`.
+    - `durability` — the durable-ack contract against the wrapper's
+      attached write-ahead log (`durable/wal.py`). `"none"` (default):
+      acks are in-memory only (the pre-durability semantics, WAL or
+      not). `"batch"`: after each combiner round the worker fsyncs the
+      WAL ONCE and only then resolves the batch's futures — a response
+      a client has seen is on disk, amortizing one fsync over the
+      whole batch. `"always"`: the WAL itself fsyncs inside every
+      append (policy `always`), so durability precedes even response
+      delivery inside the wrapper; the worker adds nothing. Both
+      durable modes REQUIRE a WAL attached at frontend construction
+      (`ValueError` otherwise — a silent non-durable "durable" mode
+      would be a lie to every client).
     """
 
     queue_depth: int = 256
@@ -102,6 +122,7 @@ class ServeConfig:
     default_deadline_s: float | None = None
     drain_timeout_s: float = 30.0
     failover: bool = False
+    durability: str = "none"
 
     def __post_init__(self):
         if self.queue_depth < 1:
@@ -110,6 +131,11 @@ class ServeConfig:
             raise ValueError("batch_max_ops must be >= 1")
         if self.batch_linger_s < 0:
             raise ValueError("batch_linger_s must be >= 0")
+        if self.durability not in ("none", "batch", "always"):
+            raise ValueError(
+                f"unknown durability {self.durability!r} "
+                f"(none | batch | always)"
+            )
 
 
 @dataclasses.dataclass
@@ -294,6 +320,26 @@ class ServeFrontend:
             )
         self._nr = nr
         self.cfg = config or ServeConfig()
+        # durable-ack wiring (`durable/`): both durable modes need the
+        # WAL present NOW — discovering its absence at the first batch
+        # would resolve futures that were promised durability
+        if self.cfg.durability != "none":
+            wal = getattr(nr, "wal", None)
+            if wal is None:
+                raise ValueError(
+                    f"durability={self.cfg.durability!r} requires a "
+                    f"WAL attached to the wrapper (attach_wal)"
+                )
+            if (self.cfg.durability == "always"
+                    and wal.policy != "always"):
+                raise ValueError(
+                    "durability='always' needs WAL fsync policy "
+                    f"'always' (WAL has {wal.policy!r}); with a "
+                    "weaker policy acks would outrun fsync"
+                )
+        # fsync barrier per batch only in "batch" mode ("always" is
+        # already durable inside the wrapper's append)
+        self._durable_sync = self.cfg.durability == "batch"
         # guards _queues/_workers/_read_tokens/_closed topology changes
         # (grow, close); the hot submit path reads the dicts lock-free
         # (GIL-atomic lookups; workers are keyed once at creation)
@@ -313,6 +359,8 @@ class ServeFrontend:
         #: lifecycle callback `fn(rid, exc)` — the `fault/` manager
         #: installs itself here to quarantine + repair + restart
         self.on_replica_failed: Callable[[int, BaseException], None] | None = None
+        #: set by `from_recovery` (durable/recovery.py:RecoveryReport)
+        self.recovery_report = None
 
         reg = get_registry()
         self._m_submitted = reg.counter("serve.submitted")
@@ -339,6 +387,51 @@ class ServeFrontend:
             self.start()
 
     # ------------------------------------------------------------ lifecycle
+
+    @classmethod
+    def from_recovery(
+        cls,
+        directory: str,
+        dispatch,
+        config: "ServeConfig | None" = None,
+        rids: Sequence[int] | None = None,
+        auto_start: bool = True,
+        nr_kwargs: dict | None = None,
+    ) -> "ServeFrontend":
+        """Reopen serving state after a crash: `recover_fleet` rebuilds
+        the wrapper from `directory` (newest valid snapshot + WAL tail
+        replayed through the same dispatch scan — bit-identical to the
+        pre-crash fleet), re-attaches the WAL at the recovered tail,
+        and this builds a frontend over it so traffic resumes where the
+        fsync-acked history ends. The WAL's fsync policy follows
+        `config.durability` (`"none"`/`"batch"`/`"always"`); the
+        `RecoveryReport` is exposed as `frontend.recovery_report`.
+        A missing/empty directory boots (and starts journaling) a
+        fresh fleet — `from_recovery` is therefore also the canonical
+        cold-start entry for a durable serve deployment."""
+        from node_replication_tpu.durable.recovery import recover_fleet
+
+        config = config or ServeConfig()
+        # WAL fsync policy mirrors the ack contract; "none" durability
+        # still journals (batch-style, caller/close syncs only)
+        policy = (
+            config.durability if config.durability != "none"
+            else "batch"
+        )
+        nr, report = recover_fleet(
+            directory, dispatch, policy=policy, attach=True,
+            nr_kwargs=nr_kwargs,
+        )
+        fe = cls(nr, config, rids=rids, auto_start=auto_start)
+        fe.recovery_report = report
+        return fe
+
+    @property
+    def nr(self):
+        """The wrapped `NodeReplicated`/`MultiLogReplicated` (read
+        access for recovery verification and ops tooling; mutate it
+        only through the frontend)."""
+        return self._nr
 
     def _new_replica(self, rid: int):
         """Build the queue/worker/token/gauge quad for one replica;
@@ -742,6 +835,29 @@ class ServeFrontend:
                 "serve worker r%d: batch of %d failed", rid, len(live)
             )
             return
+        if self._durable_sync:
+            # durable-ack barrier (`ServeConfig(durability="batch")`):
+            # ONE fsync covers the whole batch; futures resolve only
+            # past it, so an acked response is on disk. A failed fsync
+            # is post-append by definition (the ops are in the log and
+            # WILL replay in-process) — reject with maybe_executed
+            # semantics rather than ack a durability promise the disk
+            # refused.
+            try:
+                self._nr.wal_sync()
+            except Exception as e:
+                q.batch_done(0, missed)
+                logger.exception(
+                    "serve worker r%d: WAL fsync failed for batch of "
+                    "%d", rid, len(live)
+                )
+                if self.cfg.failover:
+                    raise _ReplicaDown(
+                        e, live, maybe_executed=True
+                    ) from e
+                for req in live:
+                    req.future._reject(e)
+                return
         dur = time.perf_counter() - t0
         for req, resp in zip(live, resps):
             req.future._resolve(resp)

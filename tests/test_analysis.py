@@ -693,6 +693,79 @@ class TestSwallowedWorkerException:
         assert len(hits) == 1 and hits[0].suppressed
 
 
+class TestNonDurablePublish:
+    def test_rename_without_fsync_fires(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import os
+
+            def publish(path, data):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+        """)
+        assert len(firing(diags, "non-durable-publish")) == 1
+
+    def test_bare_savez_to_path_fires(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import numpy as np
+
+            def snapshot(path, arr):
+                np.savez(path, arr=arr)
+        """)
+        assert len(firing(diags, "non-durable-publish")) == 1
+
+    def test_fsync_before_rename_clean(self, tmp_path):
+        # the core/checkpoint.py:save_snapshot discipline
+        diags = lint_src(tmp_path, """
+            import os
+
+            import numpy as np
+
+            def publish(path, payload):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    np.savez(f, **payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                dfd = os.open(os.path.dirname(path), os.O_RDONLY)
+                os.fsync(dfd)
+                os.close(dfd)
+        """)
+        assert not firing(diags, "non-durable-publish")
+
+    def test_text_and_append_modes_not_in_scope(self, tmp_path):
+        # CSV header rewrites and append-only journals are not
+        # publish points (harness/mkbench.py:_append_csv,
+        # durable/wal.py segment appends)
+        diags = lint_src(tmp_path, """
+            import os
+
+            def rewrite_csv(path, rows):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as g:
+                    g.write(rows)
+                os.replace(tmp, path)
+
+            def journal(path, data):
+                with open(path, "ab") as f:
+                    f.write(data)
+        """)
+        assert not firing(diags, "non-durable-publish")
+
+    def test_rename_with_no_prior_write_clean(self, tmp_path):
+        # renaming something this scope never wrote (a compiler's
+        # output, a download) is not the torn-publish pattern
+        diags = lint_src(tmp_path, """
+            import os
+
+            def install(tmp, final):
+                os.replace(tmp, final)
+        """)
+        assert not firing(diags, "non-durable-publish")
+
+
 class TestRepoIsClean:
     def test_package_lints_clean(self):
         # the CI gate, as a test: every violation in the package is
