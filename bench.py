@@ -717,6 +717,460 @@ def crash_main(args) -> int:
     return 0
 
 
+def follower_primary_main(args) -> int:
+    """`--follower-primary` (internal): the PRIMARY process of the
+    follower-fleet harness. The `--crash-child` durable-ack seqreg
+    loop (acks journaled to `<dir>/acks.log` only after `result()`)
+    with the replication plane attached: a `ReplicationShipper`
+    streams the WAL into `--feed-dir` and is installed as the
+    frontend's `ack_barrier`, so every acked op is BOTH fsynced and
+    shipped (ship-before-ack — the property that makes the parent's
+    zero-lost-acks gate meaningful across a promotion). Never exits on
+    its own: the parent SIGKILLs it at a seeded ack count."""
+    import os
+    import threading
+
+    from node_replication_tpu import NodeReplicated
+    from node_replication_tpu.durable import (
+        WriteAheadLog,
+        save_durable_snapshot,
+    )
+    from node_replication_tpu.models import SR_SET, make_seqreg
+    from node_replication_tpu.repl import DirectoryFeed, ReplicationShipper
+    from node_replication_tpu.serve import (
+        RetryPolicy,
+        ServeConfig,
+        ServeFrontend,
+        call_with_retry,
+    )
+
+    d = args.crash_dir
+    clients = args.serve_clients
+    nr = NodeReplicated(
+        make_seqreg(clients),
+        n_replicas=max(1, args.serve_replicas),
+        log_entries=1 << 15,
+        gc_slack=512,
+        exec_window=256,
+    )
+    wal = WriteAheadLog(os.path.join(d, "wal"),
+                        policy=args.crash_durability)
+    nr.attach_wal(wal)
+    feed = DirectoryFeed(args.feed_dir, arg_width=nr.spec.arg_width)
+    shipper = ReplicationShipper(wal, feed, poll_s=0.002,
+                                 heartbeat_interval_s=0.02)
+    cfg = ServeConfig(
+        queue_depth=args.serve_queue_depth,
+        batch_max_ops=args.serve_batch,
+        batch_linger_s=args.serve_linger,
+        durability=args.crash_durability,
+    )
+    fe = ServeFrontend(nr, cfg)
+    fe.ack_barrier = shipper.barrier  # ship-before-ack
+    rids = fe.rids
+    ack_lock = threading.Lock()
+    ack_f = open(os.path.join(d, "acks.log"), "a")
+    acked = [0]
+    retry = RetryPolicy(max_attempts=64, base_backoff_s=0.001,
+                       max_backoff_s=0.1)
+
+    def client(c: int) -> None:
+        i = 1
+        while True:
+            resp = call_with_retry(
+                fe, (SR_SET, c, i), rid=rids[c % len(rids)],
+                policy=retry,
+            )
+            with ack_lock:
+                if resp != i - 1:
+                    ack_f.write(f"ERR {c} {i} {resp}\n")
+                else:
+                    ack_f.write(f"{c} {i}\n")
+                ack_f.flush()
+                acked[0] += 1
+            i += 1
+
+    for c in range(clients):
+        threading.Thread(target=client, args=(c,),
+                         daemon=True).start()
+    # one durable snapshot mid-stream: raises the WAL reclaim floor,
+    # so the run also exercises the reclaim-vs-ship pin interplay
+    snap_after = args.crash_snapshot_after
+    while True:
+        time.sleep(0.02)
+        if snap_after > 0:
+            with ack_lock:
+                n = acked[0]
+            if n >= snap_after:
+                save_durable_snapshot(nr, d)
+                snap_after = 0  # once
+
+
+def follower_main(args) -> int:
+    """`--follower`: the replication gate (ISSUE 6).
+
+    Forks a primary serve loop (durable, shipped acks — see
+    `--follower-primary`), follows its feed with an IN-PROCESS
+    `Follower` (a second, independent fleet in this process: the
+    multi-process split runs primary | follower), and verifies, with
+    hard exits:
+
+    - **bounded staleness**: reads served by the follower at
+      `max_lag_pos` never observe an applied position older than the
+      bound (checked per read), and per-client values are monotone;
+    - **failover**: SIGKILL of the primary at a seeded ack count is
+      detected by heartbeat silence (`fault/` health machine), the
+      most-advanced follower is promoted (feed drained under
+      torn-tail rules, epoch fenced), and the measured RTO
+      (detect + promote) is reported;
+    - **no lost ack**: every fsync-and-ship-acked `(client, i)` is in
+      the promoted registers;
+    - **no duplicate**: the promoted follower's WAL per-slot history
+      is exactly `1..k` in order;
+    - **bit-identity at a common position**: the primary's on-disk
+      WAL and the follower's WAL hold identical records up to
+      `min(primary durable tail, follower applied)`, and the
+      follower's live states equal a from-init replay of its own log
+      — composed, follower state IS the primary's fold;
+    - **zombie fencing**: a publish stamped with the dead primary's
+      epoch is rejected by the feed;
+    - **serves on**: clients continue their sequences through the
+      promoted frontend with durable acks.
+    """
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from node_replication_tpu.core.checkpoint import recover_states
+    from node_replication_tpu.durable import WriteAheadLog
+    from node_replication_tpu.harness.mkbench import (
+        append_replication_csv,
+        replication_rows,
+    )
+    from node_replication_tpu.models import SR_GET, SR_SET, make_seqreg
+    from node_replication_tpu.repl import (
+        DirectoryFeed,
+        EpochFencedError,
+        Follower,
+        PromotionManager,
+    )
+    from node_replication_tpu.serve import ServeConfig, StaleRead
+
+    clients = args.serve_clients
+    kill_after = args.follower_kill_after_acks
+    if kill_after <= 0:
+        import random as _random
+
+        kill_after = _random.Random(args.seed).randrange(250, 600)
+    snap_after = args.crash_snapshot_after
+    if snap_after < 0:
+        snap_after = kill_after // 2
+    max_lag = args.follower_max_lag
+    base = args.follower_dir or tempfile.mkdtemp(prefix="nr-follower-")
+    primary_d = os.path.join(base, "primary")
+    feed_d = os.path.join(base, "feed")
+    follower_d = os.path.join(base, "follower")
+    for p in (primary_d, feed_d, follower_d):
+        os.makedirs(p, exist_ok=True)
+    acks_path = os.path.join(primary_d, "acks.log")
+    failures: list[str] = []
+
+    dispatch = make_seqreg(clients)
+    feed = DirectoryFeed(feed_d, arg_width=dispatch.arg_width)
+    follower = Follower(
+        dispatch, feed, follower_d,
+        config=ServeConfig(
+            queue_depth=args.serve_queue_depth,
+            batch_max_ops=args.serve_batch,
+            batch_linger_s=args.serve_linger,
+            durability="batch",
+        ),
+        poll_s=0.002,
+        nr_kwargs=dict(n_replicas=1, log_entries=1 << 15,
+                       gc_slack=512, exec_window=256),
+    )
+    manager = PromotionManager(
+        feed, [follower],
+        heartbeat_timeout_s=args.follower_heartbeat_timeout,
+        check_interval_s=0.03,
+    )
+    manager.start()
+
+    child_log = open(os.path.join(base, "child.log"), "w")
+    child = subprocess.Popen(
+        [
+            sys.executable, os.path.abspath(__file__),
+            "--follower-primary",
+            "--crash-dir", primary_d,
+            "--feed-dir", feed_d,
+            "--serve-clients", str(clients),
+            "--serve-replicas", str(args.serve_replicas),
+            "--serve-queue-depth", str(args.serve_queue_depth),
+            "--serve-batch", str(args.serve_batch),
+            "--serve-linger", str(args.serve_linger),
+            "--crash-durability", "batch",
+            "--crash-snapshot-after", str(snap_after),
+            "--seed", str(args.seed),
+        ],
+        stdout=child_log, stderr=child_log,
+    )
+
+    def ack_lines() -> list[str]:
+        try:
+            with open(acks_path) as f:
+                data = f.read()
+        except FileNotFoundError:
+            return []
+        lines = data.split("\n")
+        return [ln for ln in lines[:-1] if ln]  # drop partial tail
+
+    # ---- phase 1: staleness-bounded follower reads under load ------
+    reads = 0
+    stale_reads = 0
+    last_seen = [0] * clients
+    t_end = time.monotonic() + args.follower_timeout
+    killed = False
+    t_kill = None
+    while time.monotonic() < t_end:
+        if child.poll() is not None:
+            break
+        if len(ack_lines()) >= kill_after:
+            os.kill(child.pid, signal.SIGKILL)
+            t_kill = time.monotonic()
+            killed = True
+            break
+        c = reads % clients
+        try:
+            v, applied, bound = follower.read_result(
+                (SR_GET, c), max_lag_pos=max_lag, wait_s=0.25,
+            )
+        except StaleRead:
+            stale_reads += 1
+            continue
+        finally:
+            reads += 1
+        if applied < bound:
+            failures.append(
+                f"read {reads} served below its staleness bound: "
+                f"applied {applied} < bound {bound} (max_lag_pos "
+                f"{max_lag})"
+            )
+        if v < last_seen[c]:
+            failures.append(
+                f"client {c} read went backwards: {v} after "
+                f"{last_seen[c]} (follower reads must be monotone)"
+            )
+        last_seen[c] = max(last_seen[c], v)
+    if not killed:
+        if child.poll() is None:
+            os.kill(child.pid, signal.SIGKILL)
+            failures.append(
+                f"primary reached only {len(ack_lines())} acks within "
+                f"{args.follower_timeout}s (wanted {kill_after}); see "
+                f"{base}/child.log"
+            )
+        else:
+            failures.append(
+                f"primary exited early (rc {child.returncode}) before "
+                f"the seeded kill; see {base}/child.log"
+            )
+        t_kill = time.monotonic()
+    child.wait()
+    child_log.close()
+
+    # what the clients were TOLD is durable AND shipped
+    acked_max = [0] * clients
+    acked_total = 0
+    for ln in ack_lines():
+        parts = ln.split()
+        if parts[0] == "ERR":
+            failures.append(f"primary observed oracle violation: {ln}")
+            continue
+        c, i = int(parts[0]), int(parts[1])
+        if i != acked_max[c] + 1:
+            failures.append(
+                f"client {c} ack sequence broken at {i} "
+                f"(after {acked_max[c]})"
+            )
+        acked_max[c] = max(acked_max[c], i)
+        acked_total += 1
+
+    # ---- phase 2: detection + election + promotion (measured RTO) --
+    report = manager.wait(timeout=args.follower_timeout)
+    rto_wall = time.monotonic() - t_kill
+    if report is None:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        print("# FAIL: promotion did not complete (no report)",
+              file=sys.stderr)
+        return 1
+    if not follower.promoted or follower.frontend.read_only:
+        failures.append("follower not serving writes after promotion")
+
+    # no lost ack: every acked value is in the promoted registers
+    lost = 0
+    values = []
+    for c in range(clients):
+        v = follower.frontend.read((SR_GET, c), rid=0)
+        values.append(v)
+        if v < acked_max[c]:
+            lost += acked_max[c] - v
+            failures.append(
+                f"client {c}: acked up to {acked_max[c]} but the "
+                f"promoted follower holds {v} (LOST ACKED WRITES)"
+            )
+
+    # no duplicate: the follower's journaled per-slot history chains
+    duplicated = 0
+    seen_next = [1] * clients
+    for rec in follower.nr.wal.records(0):
+        for opc, row in zip(rec.opcodes, rec.args):
+            c, v = int(row[0]) % clients, int(row[1])
+            if v < seen_next[c]:
+                duplicated += 1
+                failures.append(
+                    f"client {c}: follower WAL holds value {v} again "
+                    f"after reaching {seen_next[c] - 1} (DUPLICATED)"
+                )
+            elif v > seen_next[c]:
+                failures.append(
+                    f"client {c}: follower WAL skips from "
+                    f"{seen_next[c] - 1} to {v} (hole in history)"
+                )
+                seen_next[c] = v + 1
+            else:
+                seen_next[c] += 1
+
+    # bit-identity at a common position: the primary's on-disk WAL and
+    # the follower's WAL must hold IDENTICAL records up to
+    # min(primary durable tail, follower applied) — with deterministic
+    # replay (checked next) that makes the states folds of the same
+    # history, i.e. bit-identical at that position
+    primary_wal = WriteAheadLog(os.path.join(primary_d, "wal"),
+                                policy="batch",
+                                arg_width=dispatch.arg_width)
+    common = min(primary_wal.tail, follower.applied_pos())
+    base_pos = max(primary_wal.base, follower.nr.wal.base)
+    mismatches = 0
+    p_iter = primary_wal.records(base_pos)
+    f_iter = follower.nr.wal.records(base_pos)
+
+    def flat_ops(it, upto):
+        for rec in it:
+            for j in range(rec.count):
+                pos = rec.pos + j
+                if pos >= upto:
+                    return
+                yield pos, int(rec.opcodes[j]), tuple(
+                    int(a) for a in rec.args[j]
+                )
+
+    for (pp, po, pa), (fp, fo, fa) in zip(
+        flat_ops(p_iter, common), flat_ops(f_iter, common)
+    ):
+        if (pp, po, pa) != (fp, fo, fa):
+            mismatches += 1
+            if mismatches <= 3:
+                failures.append(
+                    f"common-position divergence at {pp}: primary "
+                    f"({po}, {pa}) vs follower ({fo}, {fa})"
+                )
+    primary_wal.close()
+
+    # ...and the follower's live states equal a from-init replay of
+    # its own recovered log (the same determinism clause --crash pins)
+    import jax
+
+    _, replay_states = recover_states(dispatch, follower.nr.spec,
+                                      follower.nr.log)
+    for a, b in zip(jax.tree.leaves(follower.nr.states),
+                    jax.tree.leaves(replay_states)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            failures.append(
+                "promoted follower states are NOT bit-identical to "
+                "replaying its log from init"
+            )
+            break
+
+    # zombie fencing: the dead primary's epoch must be rejected
+    try:
+        feed.publish(report.new_epoch - 1, follower.applied_pos(),
+                     np.zeros(1, np.int32),
+                     np.zeros((1, dispatch.arg_width), np.int32))
+        failures.append(
+            "feed accepted a publish stamped with the dead primary's "
+            "epoch (zombie not fenced)"
+        )
+    except EpochFencedError:
+        pass
+
+    # serves on: continue each client's sequence with durable acks
+    post_ops = 0
+    for c in range(clients):
+        for i in range(values[c] + 1, values[c] + 4):
+            resp = follower.frontend.call((SR_SET, c, i), rid=0)
+            if resp != i - 1:
+                failures.append(
+                    f"post-promotion client {c} op {i}: expected "
+                    f"{i - 1}, got {resp}"
+                )
+            post_ops += 1
+    follower.close()
+
+    append_replication_csv(args.serve_out, replication_rows(
+        "bench", report, clients=clients, acked=acked_total,
+        kill_after=kill_after, max_lag_pos=max_lag, reads=reads,
+        stale_reads=stale_reads, lost=lost, duplicated=duplicated,
+        post_restart_ops=post_ops,
+    ))
+    print(json.dumps({
+        "metric": "follower_failover_rto",
+        "value": round(report.rto_s, 4),
+        "unit": "seconds",
+        "clients": clients,
+        "acked_before_kill": acked_total,
+        "kill_after_acks": kill_after,
+        "max_lag_pos": max_lag,
+        "follower_reads": reads,
+        "stale_reads": stale_reads,
+        "applied_pos": report.applied_pos,
+        "new_epoch": report.new_epoch,
+        "drained_records": report.drained_records,
+        "detect_s": round(report.detect_s, 4),
+        "promote_s": round(report.promote_s, 4),
+        "rto_s": round(report.rto_s, 4),
+        "rto_wall_s": round(rto_wall, 4),
+        "lost": lost,
+        "duplicated": duplicated,
+        "common_position": int(common),
+        "record_mismatches": mismatches,
+        "post_restart_ops": post_ops,
+        "bit_identical": not any("bit-identical" in f or
+                                 "divergence" in f for f in failures),
+    }))
+    if not args.follower_dir:
+        shutil.rmtree(base, ignore_errors=True)
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# follower OK: {reads} staleness-bounded reads "
+        f"(max_lag_pos={max_lag}, {stale_reads} typed stale "
+        f"rejections) over {acked_total} shipped acks; SIGKILL -> "
+        f"promotion in {report.rto_s:.3f}s (detect "
+        f"{report.detect_s:.3f}s + promote {report.promote_s:.3f}s), "
+        f"lost 0, duplicated 0, bit-identical at position {common}, "
+        f"served {post_ops} more ops at epoch {report.new_epoch}",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--replicas", type=int, default=4096)
@@ -827,15 +1281,60 @@ def main():
     crash.add_argument("--crash-timeout", type=float, default=90.0,
                        help="parent gives up waiting for the kill "
                             "point after this many seconds")
+    follower = p.add_argument_group(
+        "follower", "replication benchmark (--follower): fork a "
+                    "primary serve loop with shipped durable acks, "
+                    "follow its WAL feed in-process, verify "
+                    "staleness-bounded reads, SIGKILL the primary, "
+                    "and exit 1 unless a promotion with zero "
+                    "lost/duplicated acked writes completes — the "
+                    "measured RTO is the reported metric")
+    follower.add_argument("--follower", action="store_true",
+                          help="run the follower-replication "
+                               "benchmark (reuses the --serve-* "
+                               "knobs for load shape)")
+    follower.add_argument("--follower-primary", action="store_true",
+                          help=argparse.SUPPRESS)  # internal: primary
+    follower.add_argument("--feed-dir", default=None,
+                          help=argparse.SUPPRESS)  # internal: feed
+    follower.add_argument("--follower-dir", default=None,
+                          help="working directory (default: a temp "
+                               "dir, removed after a clean run)")
+    follower.add_argument("--follower-kill-after-acks", type=int,
+                          default=0,
+                          help="SIGKILL the primary once this many "
+                               "acks are shipped (0 = seeded from "
+                               "--seed)")
+    follower.add_argument("--follower-max-lag", type=int, default=64,
+                          help="staleness bound (positions) for the "
+                               "verified follower reads")
+    follower.add_argument("--follower-heartbeat-timeout", type=float,
+                          default=0.5,
+                          help="heartbeat silence before the "
+                               "promotion watch strikes the primary")
+    follower.add_argument("--follower-timeout", type=float,
+                          default=90.0,
+                          help="parent gives up waiting for the kill "
+                               "point / promotion after this many "
+                               "seconds")
     args = p.parse_args()
     if args.max_attempts < 1:
         p.error("--max-attempts must be >= 1")
-    if sum(map(bool, (args.chaos, args.serve, args.crash))) > 1:
-        p.error("--chaos, --serve and --crash are mutually exclusive")
+    if sum(map(bool, (args.chaos, args.serve, args.crash,
+                      args.follower))) > 1:
+        p.error("--chaos, --serve, --crash and --follower are "
+                "mutually exclusive")
     if args.crash_child:
         if not args.crash_dir:
             p.error("--crash-child requires --crash-dir")
         sys.exit(crash_child_main(args))
+    if args.follower_primary:
+        if not args.crash_dir or not args.feed_dir:
+            p.error("--follower-primary requires --crash-dir and "
+                    "--feed-dir")
+        sys.exit(follower_primary_main(args))
+    if args.follower:
+        sys.exit(follower_main(args))
     if args.crash:
         sys.exit(crash_main(args))
     if args.chaos:

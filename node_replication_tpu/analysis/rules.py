@@ -851,6 +851,12 @@ _EXC_HEALTH_ATTRS = frozenset({
     "report_worker_exception", "report_exception", "report_stall",
     "report_failure", "quarantine", "transition",
     "_fail_replica", "fail_replica", "on_replica_failed",
+    # repl/ worker threads (shipper ship loop, follower apply loop,
+    # promotion watch): `_record_failure` is their sanctioned report
+    # path — it stores the error for barrier/read callers AND calls
+    # the health API, so a handler routing through it has surfaced
+    # the failure
+    "_record_failure", "record_failure",
 })
 _BROAD_EXC_NAMES = frozenset({"Exception", "BaseException"})
 

@@ -387,6 +387,16 @@ class NodeReplicated:
         return self.spec.n_replicas
 
     @_locked
+    def ltail(self, rid: int) -> int:
+        """Replica `rid`'s applied cursor (host int). Locked: an
+        unlocked read races the exec round's buffer donation (the old
+        `log` arrays are DELETED once donated) — the bounded-staleness
+        read path (`serve/frontend.py`, `repl/`) polls this."""
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        return int(np.asarray(self.log.ltails)[rid])
+
+    @_locked
     def register(self, rid: int = 0) -> ReplicaToken:
         """Register a logical thread on replica `rid`
         (`Replica::register`, `nr/src/replica.rs:279-298`)."""

@@ -46,12 +46,18 @@ last fsync — recovery's replay bound.
 
 Reclamation is keyed to the log's GC head (`core/log.py`): the wrapper
 reports head progress through `maybe_reclaim`, and whole segments
-strictly below `min(head, reclaim_floor)` are deleted —
+strictly below `min(head, reclaim_floor, pins…)` are deleted —
 `reclaim_floor` is raised to the newest durable snapshot's position
 (`durable/recovery.py:save_durable_snapshot`), because recovery needs
 the WAL only from the snapshot forward; without a snapshot the floor
 stays 0 and nothing is ever reclaimed (replay-from-init needs the
-whole history).
+whole history). **Pins** (`set_pin`/`clear_pin`) let consumers that
+stream the WAL hold reclamation below their own cursor: the
+replication shipper (`repl/shipper.py`, this module's streaming
+consumer — it ships closed segments plus a tailing feed of the active
+one to follower fleets) pins its ship cursor so an unshipped segment
+can never be deleted out from under an attached follower, however far
+the snapshot floor and GC head have advanced.
 
 Fault sites (`fault/inject.py`): `wal-open`, `wal-append`, `wal-fsync`
 fire at the top of the corresponding operations; the `corrupt-bytes`
@@ -175,6 +181,10 @@ class WriteAheadLog:
         #: newest durable snapshot position (`save_durable_snapshot`
         #: raises it); reclamation never passes min(GC head, floor)
         self.reclaim_floor = 0
+        # named reclamation pins (`set_pin`): each holds the effective
+        # reclaim floor at or below its position while present — the
+        # shipper's ship cursor (`repl/shipper.py`) lives here
+        self._pins: dict[str, int] = {}
         self._lock = threading.Lock()
         self._fh = None  # active segment append handle
         self._segments: list[tuple[int, str]] = []  # (base, path) sorted
@@ -498,12 +508,40 @@ class WriteAheadLog:
 
     # ------------------------------------------------------- reclaim
 
+    def set_pin(self, name: str, pos: int) -> None:
+        """Hold reclamation at or below logical `pos` under `name`.
+        A streaming consumer (the replication shipper, `repl/`) pins
+        its cursor BEFORE reading and advances the pin only after the
+        read content is safely shipped, so reclamation can never
+        outrun it. Re-pinning the same name moves it."""
+        with self._lock:
+            self._pins[name] = int(pos)
+
+    def clear_pin(self, name: str) -> None:
+        """Release a reclamation pin (missing names are a no-op)."""
+        with self._lock:
+            self._pins.pop(name, None)
+
+    def pins(self) -> dict:
+        """Current reclamation pins (name -> position)."""
+        with self._lock:
+            return dict(self._pins)
+
+    def _pin_floor_locked(self, floor: int) -> int:
+        if self._pins:
+            floor = min(floor, min(self._pins.values()))
+        return floor
+
     def reclaim(self, floor: int) -> int:
         """Delete whole segments strictly below logical `floor` (a
         segment is deletable only when a NEWER segment exists and
-        starts at or below the floor). Returns segments deleted."""
+        starts at or below the floor). The floor is re-clamped to the
+        pins UNDER the lock — a pin set between the caller computing
+        its floor and this deletion still protects its segments (the
+        reclaim-vs-ship race). Returns segments deleted."""
         deleted = 0
         with self._lock:
+            floor = self._pin_floor_locked(int(floor))
             while (len(self._segments) >= 2
                    and self._segments[1][0] <= floor):
                 base, path = self._segments.pop(0)
@@ -519,12 +557,18 @@ class WriteAheadLog:
 
     def maybe_reclaim(self, gc_head: int) -> int:
         """GC-head coupling (`core/replica._exec_round`): reclaim up to
-        `min(gc_head, reclaim_floor)` — the log has logically consumed
-        the prefix AND a durable snapshot covers it. O(1) when nothing
-        is reclaimable (the per-round hot-path case)."""
+        `min(gc_head, reclaim_floor, pins…)` — the log has logically
+        consumed the prefix, a durable snapshot covers it, AND every
+        attached streaming consumer has shipped past it. One
+        uncontended lock acquire + O(1) when nothing is reclaimable
+        (the per-round hot-path case); the pin floor must be read
+        under the lock — iterating `_pins` while `clear_pin` pops
+        concurrently raises."""
         floor = min(int(gc_head), self.reclaim_floor)
-        if len(self._segments) < 2 or self._segments[1][0] > floor:
-            return 0
+        with self._lock:
+            floor = self._pin_floor_locked(floor)
+            if len(self._segments) < 2 or self._segments[1][0] > floor:
+                return 0
         return self.reclaim(floor)
 
     # ------------------------------------------------------- lifecycle
@@ -559,6 +603,7 @@ class WriteAheadLog:
                 "segments": len(self._segments),
                 "policy": self.policy,
                 "reclaim_floor": self.reclaim_floor,
+                "pins": dict(self._pins),
             }
 
     # ------------------------------------------------- fault plumbing

@@ -53,9 +53,13 @@ from node_replication_tpu.utils.trace import get_tracer
 
 # Every armable site, in hook order of the write path; the `wal-*`
 # sites are the durability plane's choke points (`durable/wal.py`:
-# segment open/scan, record append, fsync barrier).
+# segment open/scan, record append, fsync barrier); `ship` and
+# `repl-apply` are the replication plane's (`repl/shipper.py` ship
+# loop, `repl/follower.py` apply loop — a raise there exercises the
+# worker-failure reporting the follower-fleet gates depend on).
 SITES = ("replay", "append", "read-sync", "serve-batch",
-         "wal-append", "wal-fsync", "wal-open")
+         "wal-append", "wal-fsync", "wal-open",
+         "ship", "repl-apply")
 ACTIONS = ("raise", "stall", "corrupt", "corrupt-bytes")
 
 # Upper bound on an injected stall: stalls must stay bounded so a

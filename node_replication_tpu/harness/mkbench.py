@@ -59,6 +59,19 @@ BASELINE_CSV = "baseline_comparison.csv"
 SERVE_CSV = "serve_benchmarks.csv"
 CHAOS_CSV = "chaos_benchmarks.csv"
 RECOVERY_CSV = "recovery_benchmarks.csv"
+REPLICATION_CSV = "replication_benchmarks.csv"
+# One row per follower-failover measurement (`bench.py --follower`):
+# the staleness-bounded read-scale-out phase (reads served against a
+# live follower, stale rejections counted) and the failover phase —
+# SIGKILL of the primary, heartbeat detection, most-advanced election,
+# promotion — with the measured RTO split (detect + promote) and the
+# two hard gates (lost/duplicated fsync-acked writes, both must be 0).
+_REPLICATION_FIELDS = [
+    "name", "clients", "acked", "kill_after_acks", "max_lag_pos",
+    "reads", "stale_reads", "applied_pos", "new_epoch",
+    "drained_records", "detect_s", "promote_s", "rto_s",
+    "lost", "duplicated", "post_restart_ops",
+]
 # One row per crash-recovery measurement (`bench.py --crash`): what
 # the seeded SIGKILL destroyed vs. what recovery restored — fsync-acked
 # ops before the kill, the snapshot/WAL split the restart replayed
@@ -962,6 +975,38 @@ def recovery_rows(name: str, report, *, clients: int, durability: str,
 def append_recovery_csv(out_dir: str, rows: list[dict]) -> None:
     _append_csv(os.path.join(out_dir, RECOVERY_CSV),
                 _RECOVERY_FIELDS, rows)
+
+
+def replication_rows(name: str, report, *, clients: int, acked: int,
+                     kill_after: int, max_lag_pos: int, reads: int,
+                     stale_reads: int, lost: int, duplicated: int,
+                     post_restart_ops: int) -> list[dict]:
+    """The REPLICATION_CSV row for one follower-failover measurement
+    (`report` is a `repl/promote.py:PromotionReport`; the kwargs carry
+    what the follower harness observed around it)."""
+    return [{
+        "name": f"{name}/follower-seqreg",
+        "clients": clients,
+        "acked": acked,
+        "kill_after_acks": kill_after,
+        "max_lag_pos": max_lag_pos,
+        "reads": reads,
+        "stale_reads": stale_reads,
+        "applied_pos": report.applied_pos,
+        "new_epoch": report.new_epoch,
+        "drained_records": report.drained_records,
+        "detect_s": round(report.detect_s, 4),
+        "promote_s": round(report.promote_s, 4),
+        "rto_s": round(report.rto_s, 4),
+        "lost": lost,
+        "duplicated": duplicated,
+        "post_restart_ops": post_restart_ops,
+    }]
+
+
+def append_replication_csv(out_dir: str, rows: list[dict]) -> None:
+    _append_csv(os.path.join(out_dir, REPLICATION_CSV),
+                _REPLICATION_FIELDS, rows)
 
 
 def measure_native(

@@ -33,6 +33,13 @@ Sections:
   reclamations, snapshots taken, and the recovery timeline: every
   durability-plane event in order with its `t+` offset, so a
   crash-restart reads as a story (open → truncate → replay → attach).
+- **replication** (when the trace has `repl-*` events, `repl/`) —
+  shipped vs applied record/op counts, the delivery edge cases the
+  feed defines (duplicates skipped, gaps, zombie-fenced records,
+  stale reads), an apply-lag timeline (max positions behind the feed
+  tail per second, from `repl-apply` events), and every promotion
+  with its measured detect/promote/RTO split (`repl-promote` /
+  `repl-rto`).
 
 Pure stdlib on purpose: on a machine without jax, copy this file next
 to the trace and run it directly (`python report.py trace.jsonl`) —
@@ -267,6 +274,57 @@ def analyze(events: list[dict]) -> dict:
             "timeline": timeline_d,
         }
 
+    # replication section: ship/apply volume, delivery edge cases,
+    # apply-lag timeline, promotions with RTO split (repl/)
+    repl = None
+    ships = [e for e in events if e.get("event") == "repl-ship"]
+    applies = [e for e in events if e.get("event") == "repl-apply"]
+    repl_other = [e for e in events
+                  if str(e.get("event", "")).startswith("repl-")
+                  and e.get("event") not in ("repl-ship", "repl-apply")]
+    if ships or applies or repl_other:
+        lag_tl: dict[int, int] = {}
+        for e in applies:
+            sec = int(_event_time(e, mono0, ts0))
+            lag_tl[sec] = max(lag_tl.get(sec, 0), int(e.get("lag", 0)))
+        promotions = []
+        rtos = {e.get("follower"): e for e in events
+                if e.get("event") == "repl-rto"}
+        for e in events:
+            if e.get("event") != "repl-promote":
+                continue
+            rto = rtos.get(e.get("name"), {})
+            promotions.append({
+                "t": round(_event_time(e, mono0, ts0), 3),
+                "follower": e.get("name", "?"),
+                "epoch": e.get("epoch"),
+                "applied": e.get("applied"),
+                "drained_records": e.get("drained_records", 0),
+                "promote_s": float(e.get("duration_s", 0.0)),
+                "detect_s": float(rto.get("detect_s", 0.0)),
+                "rto_s": float(rto.get("rto_s",
+                                       e.get("duration_s", 0.0))),
+            })
+
+        def _count(name):
+            return sum(1 for e in repl_other if e.get("event") == name)
+
+        repl = {
+            "shipped_records": len(ships),
+            "shipped_ops": sum(int(e.get("n", 0)) for e in ships),
+            "applied_records": len(applies),
+            "applied_ops": sum(int(e.get("n", 0)) for e in applies),
+            "duplicates": _count("repl-dup"),
+            "fenced_records": _count("repl-fenced-record"),
+            "fenced_publishes": _count("repl-fenced-publish"),
+            "stale_reads": _count("repl-stale-read"),
+            "ship_errors": _count("repl-ship-error"),
+            "apply_errors": _count("repl-apply-error"),
+            "fences": _count("repl-fence"),
+            "apply_lag_timeline": dict(sorted(lag_tl.items())),
+            "promotions": promotions,
+        }
+
     return {
         "n_events": len(events),
         "event_counts": dict(counts),
@@ -278,6 +336,7 @@ def analyze(events: list[dict]) -> dict:
         "serve": serve,
         "fault": fault,
         "durability": durability,
+        "replication": repl,
         "stalls": [
             {"where": where, "log": log, **{k: (sorted(v)
                                                if isinstance(v, set)
@@ -405,6 +464,36 @@ def render(report: dict, out=None) -> None:
                     if k not in ("t", "event")
                 )
                 w(f"    t+{e['t']:>8.3f}s {e['event']:<17} {detail}\n")
+
+    repl = report.get("replication")
+    if repl:
+        w("\n== replication ==\n")
+        w(f"  shipped: {repl['shipped_records']} record(s) / "
+          f"{repl['shipped_ops']} op(s)   applied: "
+          f"{repl['applied_records']} record(s) / "
+          f"{repl['applied_ops']} op(s)\n")
+        w(f"  duplicates skipped: {repl['duplicates']}   "
+          f"fenced records: {repl['fenced_records']}   "
+          f"fenced publishes: {repl['fenced_publishes']}   "
+          f"stale reads: {repl['stale_reads']}\n")
+        if repl["ship_errors"] or repl["apply_errors"]:
+            w(f"  ship errors: {repl['ship_errors']}   "
+              f"apply errors: {repl['apply_errors']}\n")
+        tl = repl["apply_lag_timeline"]
+        if tl:
+            w("  apply-lag timeline (max positions behind feed tail "
+              "per second):\n")
+            peak = max(tl.values()) or 1
+            for sec in sorted(int(s) for s in tl):
+                lag = tl.get(sec, tl.get(str(sec), 0))
+                bar = "#" * max(1, round(30 * lag / peak))
+                w(f"    t+{sec:>4}s lag {lag:>8}  {bar}\n")
+        for p in repl["promotions"]:
+            w(f"  promotion t+{p['t']}s: {p['follower']} -> epoch "
+              f"{p['epoch']} at {p['applied']} "
+              f"({p['drained_records']} drained); detect "
+              f"{_fmt_s(p['detect_s'])} + promote "
+              f"{_fmt_s(p['promote_s'])} = RTO {_fmt_s(p['rto_s'])}\n")
 
     w("\n== stall report ==\n")
     if not report["stalls"]:

@@ -1,0 +1,65 @@
+"""repl/: WAL-shipping follower fleets — read scale-out, bounded
+staleness, measured-RTO promotion.
+
+The replication plane (ISSUE 6), and the first multi-process
+subsystem: the segmented write-ahead log (`durable/wal.py`) is
+already a complete, CRC-framed, position-chained replication stream,
+so a **shipper** streams it (closed segments + a tailing feed of the
+active one) into a transport-abstracted **feed**; **followers** in
+other processes replay it through the same deterministic combiner
+protocol — bit-identical state at every common position — and serve
+reads at a bounded-staleness cursor through a read-only
+`ServeFrontend`. Ship-before-ack (`shipper.barrier` as the frontend's
+`ack_barrier`) makes acks survive primary loss; on primary death the
+**promotion** path (heartbeat watch on `fault/`'s health machine)
+elects the most-advanced follower, drains the feed under the
+torn-tail rules, fences the dead primary's epoch so zombie records
+are rejected, and re-homes durable-ack write serving — classic
+log-shipping primary/replica architecture built from parts the repo
+already proves.
+
+    feed = DirectoryFeed(shared_dir)
+    shipper = ReplicationShipper(primary.wal, feed)   # on the primary
+    frontend.ack_barrier = shipper.barrier            # ship-before-ack
+
+    f = Follower(dispatch, feed, directory=my_dir)    # other process
+    v = f.read((HM_GET, k), max_lag_pos=64)           # bounded staleness
+
+    mgr = PromotionManager(feed, [f])
+    mgr.start()                                       # heartbeat watch
+    report = mgr.wait()                               # measured RTO
+"""
+
+from node_replication_tpu.repl.feed import (
+    DirectoryFeed,
+    EpochFencedError,
+    FeedCorruptError,
+    FeedError,
+    FeedGapError,
+    FeedRecord,
+)
+from node_replication_tpu.repl.follower import Follower
+from node_replication_tpu.repl.promote import (
+    PromotionManager,
+    PromotionReport,
+)
+from node_replication_tpu.repl.shipper import (
+    SHIP_PIN,
+    ReplicationShipper,
+    ShipError,
+)
+
+__all__ = [
+    "DirectoryFeed",
+    "EpochFencedError",
+    "FeedCorruptError",
+    "FeedError",
+    "FeedGapError",
+    "FeedRecord",
+    "Follower",
+    "PromotionManager",
+    "PromotionReport",
+    "ReplicationShipper",
+    "SHIP_PIN",
+    "ShipError",
+]

@@ -24,9 +24,11 @@ from node_replication_tpu.serve.client import (
 from node_replication_tpu.serve.errors import (
     DeadlineExceeded,
     FrontendClosed,
+    NotPrimary,
     Overloaded,
     ReplicaFailed,
     ServeError,
+    StaleRead,
 )
 from node_replication_tpu.serve.frontend import (
     ServeConfig,
@@ -37,6 +39,7 @@ from node_replication_tpu.serve.future import ServeFuture
 __all__ = [
     "DeadlineExceeded",
     "FrontendClosed",
+    "NotPrimary",
     "Overloaded",
     "ReplicaFailed",
     "RetryPolicy",
@@ -44,5 +47,6 @@ __all__ = [
     "ServeError",
     "ServeFrontend",
     "ServeFuture",
+    "StaleRead",
     "call_with_retry",
 ]

@@ -23,6 +23,13 @@ flat under `ServeError`:
   (`maybe_executed=True`) the op WILL replay and only its response was
   lost — resubmitting could duplicate it, so the client must decide
   (the log is the source of truth; a read can disambiguate).
+- `StaleRead` — a bounded-staleness read (`read(min_pos=...)`, the
+  `repl/` follower read path) found the serving replica's applied
+  position still behind the requested bound after the allowed wait.
+  The read had no effect; retry later or loosen the bound.
+- `NotPrimary` — a write submitted to a read-only (follower-mode)
+  frontend (`repl/follower.py`); writes belong on the primary until a
+  promotion (`enable_writes`) re-homes write serving here.
 """
 
 from __future__ import annotations
@@ -104,3 +111,42 @@ class ReplicaFailed(ServeError):
     @property
     def retryable(self) -> bool:
         return not self.maybe_executed
+
+
+class StaleRead(ServeError):
+    """A bounded-staleness read could not be served within its bound.
+
+    The serving replica's applied position (`applied_pos`) still
+    trails the requested minimum (`min_pos`) after the caller's
+    allowed wait — the follower is lagging the feed further than the
+    client tolerates (`repl/follower.py` translates `max_lag_pos`
+    into this absolute bound). The read dispatched nothing; the
+    client can retry, loosen the bound, or route to the primary.
+    """
+
+    def __init__(self, rid: int, applied_pos: int, min_pos: int):
+        super().__init__(
+            f"replica {rid} applied position {applied_pos} trails the "
+            f"requested staleness bound {min_pos}"
+        )
+        self.rid = rid
+        self.applied_pos = applied_pos
+        self.min_pos = min_pos
+
+
+class NotPrimary(ServeError):
+    """A write reached a read-only (follower-mode) frontend.
+
+    Followers serve bounded-staleness reads only; every write belongs
+    on the primary. A promotion (`ServeFrontend.enable_writes`, driven
+    by `repl/promote.py`) flips the frontend into write serving —
+    until then the op was never admitted and retrying AGAINST THE
+    PRIMARY is always safe.
+    """
+
+    def __init__(self, rid: int):
+        super().__init__(
+            f"replica {rid} is serving read-only (follower mode); "
+            f"route writes to the primary or promote this follower"
+        )
+        self.rid = rid

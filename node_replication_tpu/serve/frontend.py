@@ -72,8 +72,10 @@ from node_replication_tpu.obs.metrics import COUNT_BUCKETS, get_registry
 from node_replication_tpu.serve.errors import (
     DeadlineExceeded,
     FrontendClosed,
+    NotPrimary,
     Overloaded,
     ReplicaFailed,
+    StaleRead,
 )
 from node_replication_tpu.serve.future import ServeFuture
 from node_replication_tpu.utils.trace import get_tracer
@@ -312,6 +314,7 @@ class ServeFrontend:
         config: ServeConfig | None = None,
         rids: Sequence[int] | None = None,
         auto_start: bool = True,
+        read_only: bool = False,
     ):
         if not hasattr(nr, "execute_mut_batch"):
             raise TypeError(
@@ -361,6 +364,16 @@ class ServeFrontend:
         self.on_replica_failed: Callable[[int, BaseException], None] | None = None
         #: set by `from_recovery` (durable/recovery.py:RecoveryReport)
         self.recovery_report = None
+        # follower mode (`repl/`): writes reject with NotPrimary until
+        # a promotion flips the frontend via enable_writes()
+        self._read_only = bool(read_only)
+        #: replication ack barrier `fn(durable_pos)` — the `repl/`
+        #: shipper installs `shipper.barrier` here so a durable-ack
+        #: batch resolves only after its records are SHIPPED to the
+        #: follower feed as well as fsynced (ship-before-ack: the
+        #: semi-synchronous mode whose acks survive primary loss
+        #: because a promoted follower provably holds them)
+        self.ack_barrier: Callable[[int], None] | None = None
 
         reg = get_registry()
         self._m_submitted = reg.counter("serve.submitted")
@@ -652,9 +665,14 @@ class ServeFrontend:
                deadline_s: float | None = None) -> ServeFuture:
         """Stage one write op on replica `rid`; returns its future.
         Raises `Overloaded` when the admission queue is full,
-        `FrontendClosed` after `close()`, and (failover mode)
-        `ReplicaFailed` while the replica is down — all BEFORE the op
+        `FrontendClosed` after `close()`, (failover mode)
+        `ReplicaFailed` while the replica is down, and (follower mode)
+        `NotPrimary` while writes are disabled — all BEFORE the op
         can have any effect."""
+        if self._read_only:
+            # follower mode (`repl/`): no write is ever admitted, so a
+            # rejected caller can safely resubmit against the primary
+            raise NotPrimary(rid)
         # closed wins over failed: a closed frontend is PERMANENT and
         # must not hand retry loops a retryable ReplicaFailed
         if not self._closed and rid in self._failed:  # GIL-atomic reads
@@ -696,15 +714,57 @@ class ServeFrontend:
         """Closed-loop convenience: `submit` + `result`."""
         return self.submit(op, rid, deadline_s).result(timeout)
 
-    def read(self, op: tuple, rid: int = 0):
+    @property
+    def read_only(self) -> bool:
+        """True while serving in follower mode (writes rejected)."""
+        return self._read_only
+
+    def enable_writes(self) -> None:
+        """Promotion re-home (`repl/promote.py`): flip a read-only
+        (follower-mode) frontend into write serving. The queues and
+        workers were live all along — only admission changes — so the
+        first write after promotion needs no warm-up. Idempotent."""
+        if not self._read_only:
+            return
+        self._read_only = False
+        get_tracer().emit("serve-enable-writes")
+
+    def read(self, op: tuple, rid: int = 0,
+             min_pos: int | None = None, wait_s: float = 0.0):
         """Read against replica `rid` via the wrapper's read-sync path
         (`execute`): waits only for THIS replica to pass the completed
         tail, then dispatches locally — never enters the write queue
-        or the log (`nr/src/replica.rs:404-410`)."""
+        or the log (`nr/src/replica.rs:404-410`).
+
+        `min_pos` is the bounded-staleness cursor (the `repl/`
+        follower read path): the read dispatches only once replica
+        `rid`'s applied position (`ltails[rid]`) has reached `min_pos`,
+        waiting up to `wait_s` seconds and then rejecting with a typed
+        `StaleRead` — a client never silently observes state older
+        than its bound. On a primary the bound is trivially satisfied
+        (the write path replays before responding)."""
         token = self._read_tokens.get(rid)
         if token is None:
             raise ValueError(f"replica {rid} is not served "
                              f"(have {self.rids})")
+        if min_pos is not None:
+            min_pos = int(min_pos)
+            ltail = getattr(self._nr, "ltail", None)
+            if ltail is None:
+                raise TypeError(
+                    f"{type(self._nr).__name__} has no ltail "
+                    f"accessor; bounded-staleness reads need it"
+                )
+            deadline = time.monotonic() + max(0.0, wait_s)
+            while True:
+                # locked cursor peek: an unlocked log read races the
+                # exec round's buffer donation (core/replica.ltail)
+                applied = ltail(rid)
+                if applied >= min_pos:
+                    break
+                if time.monotonic() >= deadline:
+                    raise StaleRead(rid, applied, min_pos)
+                time.sleep(0.0002)
         return self._nr.execute(op, token)
 
     def stats(self) -> dict:
@@ -835,21 +895,50 @@ class ServeFrontend:
                 "serve worker r%d: batch of %d failed", rid, len(live)
             )
             return
-        if self._durable_sync:
+        barrier = self.ack_barrier
+        if self._durable_sync or barrier is not None:
             # durable-ack barrier (`ServeConfig(durability="batch")`):
             # ONE fsync covers the whole batch; futures resolve only
-            # past it, so an acked response is on disk. A failed fsync
-            # is post-append by definition (the ops are in the log and
-            # WILL replay in-process) — reject with maybe_executed
-            # semantics rather than ack a durability promise the disk
-            # refused.
+            # past it, so an acked response is on disk. With a
+            # replication `ack_barrier` installed (`repl/shipper.py`)
+            # the batch additionally waits until the feed holds its
+            # records (ship-before-ack), so an acked response also
+            # survives PRIMARY loss via promotion. A failed fsync or
+            # ship is post-append by definition (the ops are in the
+            # log and WILL replay in-process) — reject with
+            # maybe_executed semantics rather than ack a durability
+            # promise the disk (or the feed) refused.
             try:
-                self._nr.wal_sync()
+                if self._durable_sync:
+                    durable = self._nr.wal_sync()
+                else:
+                    # barrier without batch-fsync (durability="always"
+                    # keeps durable_tail == tail): gate on the journal
+                    # TAIL, which covers this batch's records — gating
+                    # on durable_tail would let a policy="none" WAL
+                    # ack unshipped (even un-fsynced) ops silently;
+                    # this way the shipper (which ships only fsynced
+                    # records) times the barrier out instead, and the
+                    # misconfiguration is loud
+                    wal = getattr(self._nr, "wal", None)
+                    durable = None if wal is None else wal.tail
+                if barrier is not None:
+                    if durable is None:
+                        # an installed barrier with no journal to
+                        # gate on would otherwise be skipped silently
+                        # — acks would claim replication that never
+                        # happened
+                        raise RuntimeError(
+                            "ack_barrier installed but no WAL is "
+                            "attached; ship-before-ack needs the "
+                            "journal"
+                        )
+                    barrier(durable)
             except Exception as e:
                 q.batch_done(0, missed)
                 logger.exception(
-                    "serve worker r%d: WAL fsync failed for batch of "
-                    "%d", rid, len(live)
+                    "serve worker r%d: durable-ack barrier failed for "
+                    "batch of %d", rid, len(live)
                 )
                 if self.cfg.failover:
                     raise _ReplicaDown(

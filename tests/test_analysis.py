@@ -663,6 +663,30 @@ class TestSwallowedWorkerException:
         """)
         assert not firing(diags, "swallowed-worker-exception")
 
+    def test_record_failure_helper_sanctioned(self, tmp_path):
+        # the repl/ worker idiom (shipper ship loop, follower apply
+        # loop): a broad except routing through `_record_failure` has
+        # surfaced the failure — it stores the error for barrier/read
+        # callers AND calls the health API
+        diags = lint_src(tmp_path, """
+            import threading
+
+            class Shipper:
+                def start(self):
+                    threading.Thread(target=self._ship_loop).start()
+
+                def _ship_loop(self):
+                    try:
+                        self._ship_once()
+                    except Exception as e:
+                        self._record_failure(e)
+
+                def _record_failure(self, exc):
+                    self._error = exc
+                    self.health.report_worker_exception(0, exc)
+        """)
+        assert not firing(diags, "swallowed-worker-exception")
+
     def test_non_thread_function_is_exempt(self, tmp_path):
         # broad excepts outside worker threads are host-loop policy,
         # not this rule's concern

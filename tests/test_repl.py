@@ -1,0 +1,555 @@
+"""Replication plane: feed delivery edge cases, WAL ship pinning,
+follower apply rules, bounded-staleness reads, promotion (ISSUE 6).
+
+The contract under test: the feed delivers the WAL's record stream
+with every delivery fault given a defined rule (torn tail waits,
+duplicates skip, gaps raise typed with positions, zombie epochs are
+fenced), reclamation can never outrun an attached shipper (the
+reclaim-vs-ship race), follower state is the deterministic fold of
+shipped history (bit-identical to the primary at a common position),
+bounded-staleness reads never observe state older than their bound
+(typed `StaleRead` past the allowed wait), and promotion drains +
+fences + re-homes write serving with nothing acked lost.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from node_replication_tpu.core.replica import NodeReplicated
+from node_replication_tpu.durable import WriteAheadLog
+from node_replication_tpu.fault import FaultPlan, FaultSpec
+from node_replication_tpu.models import SR_GET, SR_SET, make_seqreg
+from node_replication_tpu.obs.metrics import get_registry
+from node_replication_tpu.repl import (
+    DirectoryFeed,
+    EpochFencedError,
+    FeedCorruptError,
+    FeedError,
+    FeedGapError,
+    Follower,
+    PromotionManager,
+    ReplicationShipper,
+    ShipError,
+)
+from node_replication_tpu.repl.feed import _message_name
+from node_replication_tpu.serve.errors import NotPrimary, StaleRead
+
+DISPATCH = make_seqreg(4)
+NR_KW = dict(n_replicas=1, log_entries=1 << 10, gc_slack=32)
+
+
+@pytest.fixture
+def metrics_on():
+    """Enable the global registry (restored after) — `repl.*` counter
+    assertions need it; instruments are one no-op branch otherwise."""
+    r = get_registry()
+    was = r.enabled
+    r.enable()
+    yield r
+    r.enabled = was
+
+
+def states_np(nr):
+    return jax.tree.map(lambda a: np.asarray(a).copy(), nr.states)
+
+
+def assert_states_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def sets(pos, pairs):
+    """(opcodes, args) arrays for a batch of SR_SET ops at `pos`."""
+    ops = np.full(len(pairs), SR_SET, np.int32)
+    args = np.zeros((len(pairs), 3), np.int32)
+    for i, (c, v) in enumerate(pairs):
+        args[i, 0] = c
+        args[i, 1] = v
+    return ops, args
+
+
+# --------------------------------------------------------------- feed unit
+
+
+class TestFeed:
+    def test_publish_poll_roundtrip(self, tmp_path):
+        feed = DirectoryFeed(str(tmp_path))
+        feed.publish(0, 0, *sets(0, [(0, 1), (1, 1)]))
+        feed.publish(0, 2, *sets(2, [(2, 1)]))
+        recs = feed.poll(0)
+        assert [r.pos for r in recs] == [0, 2]
+        assert recs[0].ops() == [(SR_SET, 0, 1, 0), (SR_SET, 1, 1, 0)]
+        assert feed.tail_pos() == 3
+        # a record straddling `start` is returned whole (the follower
+        # slices the duplicate prefix away)
+        part = feed.poll(1)
+        assert [r.pos for r in part] == [0, 2]
+        assert feed.poll(3) == []
+
+    def test_torn_tail_mid_ship_resumes_cleanly(self, tmp_path):
+        feed = DirectoryFeed(str(tmp_path))
+        feed.publish(0, 0, *sets(0, [(0, 1), (1, 1)]))
+        feed.publish(0, 2, *sets(2, [(0, 2)]))
+        # tear the newest message mid-frame: the shipper was killed
+        # mid-publish (exactly a half-shipped network frame)
+        torn = os.path.join(str(tmp_path), _message_name(2))
+        os.truncate(torn, os.path.getsize(torn) - 3)
+        # poll stops BEFORE the torn message, without error
+        assert [r.pos for r in feed.poll(0)] == [0]
+        assert feed.tail_pos() == 2
+        # a resuming shipper re-publishes over the same name (resume
+        # cursor = tail_pos) and the stream continues seamlessly
+        feed.publish(0, 2, *sets(2, [(0, 2)]))
+        assert [r.pos for r in feed.poll(0)] == [0, 2]
+        assert feed.tail_pos() == 3
+
+    def test_duplicate_publish_is_idempotent(self, tmp_path):
+        feed = DirectoryFeed(str(tmp_path))
+        for _ in range(3):  # re-ship of the same record overwrites
+            feed.publish(0, 0, *sets(0, [(0, 1)]))
+        recs = feed.poll(0)
+        assert len(recs) == 1
+        assert recs[0].ops() == [(SR_SET, 0, 1, 0)]
+
+    def test_corrupt_complete_message_raises_typed(self, tmp_path):
+        feed = DirectoryFeed(str(tmp_path))
+        feed.publish(0, 0, *sets(0, [(0, 1)]))
+        feed.publish(0, 1, *sets(1, [(0, 2)]))
+        path = os.path.join(str(tmp_path), _message_name(0))
+        with open(path, "r+b") as f:
+            f.seek(os.path.getsize(path) - 1)
+            b = f.read(1)
+            f.seek(os.path.getsize(path) - 1)
+            f.write(bytes([b[0] ^ 0x01]))
+        # a COMPLETE message failing CRC below the readable tail is
+        # bit rot, never a silent skip
+        with pytest.raises(FeedCorruptError, match="CRC") as ei:
+            feed.poll(0)
+        assert ei.value.pos == 0
+
+    def test_epoch_fencing_at_the_transport(self, tmp_path):
+        feed = DirectoryFeed(str(tmp_path))
+        assert feed.epoch() == 0
+        feed.publish(0, 0, *sets(0, [(0, 1)]))
+        assert feed.fence(3) == 3
+        # the zombie's late publish is rejected AND writes nothing
+        with pytest.raises(EpochFencedError) as ei:
+            feed.publish(0, 1, *sets(1, [(0, 2)]))
+        assert (ei.value.epoch, ei.value.current) == (0, 3)
+        assert feed.tail_pos() == 1
+        # the new primary's epoch passes; the fence never moves back
+        feed.publish(3, 1, *sets(1, [(0, 2)]))
+        with pytest.raises(FeedError, match="must exceed"):
+            feed.fence(3)
+        # the fence is durable: a fresh handle observes it
+        assert DirectoryFeed(str(tmp_path)).epoch() == 3
+
+    def test_gap_error_carries_positions(self):
+        e = FeedGapError(3, 7)
+        assert (e.expected, e.got) == (3, 7)
+        assert "[3, 7)" in str(e)
+
+    def test_prune(self, tmp_path):
+        feed = DirectoryFeed(str(tmp_path))
+        feed.publish(0, 0, *sets(0, [(0, 1), (1, 1)]))
+        feed.publish(0, 2, *sets(2, [(0, 2)]))
+        feed.publish(0, 3, *sets(3, [(0, 3)]))
+        assert feed.prune(3) == 2  # records wholly below 3
+        assert [r.pos for r in feed.poll(0)] == [3]
+
+
+# --------------------------------------------- WAL pinning (satellite 1)
+
+
+class TestWalShipPinning:
+    def _walled(self, tmp_path, n=6):
+        w = WriteAheadLog(str(tmp_path), policy="none",
+                          segment_max_bytes=64)  # rotate ~every record
+        for i in range(n):
+            w.append(i, [(SR_SET, 0, i)])
+        w.reclaim_floor = n  # a durable snapshot covers everything
+        return w
+
+    def test_pin_holds_reclaim_floor(self, tmp_path):
+        w = self._walled(tmp_path)
+        w.set_pin("ship", 2)
+        w.maybe_reclaim(6)  # min(head 6, floor 6, pin 2) = 2
+        assert w.base <= 2
+        assert [r.pos for r in w.records(2)] == [2, 3, 4, 5]
+        assert w.stats()["pins"] == {"ship": 2}
+        # releasing the pin releases the unshipped hold: reclamation
+        # proceeds to the snapshot-floor/GC-head rule alone
+        w.clear_pin("ship")
+        w.maybe_reclaim(6)
+        assert w.base > 2
+        w.close()
+
+    def test_reclaim_reclamps_under_lock(self, tmp_path):
+        # the reclaim-vs-ship race: a caller computed its floor, then
+        # a pin landed BEFORE the deletion — reclaim() must re-clamp
+        # under the lock, so the pinned segments survive
+        w = self._walled(tmp_path)
+        w.set_pin("ship", 0)
+        assert w.reclaim(6) == 0
+        assert w.base == 0
+        w.close()
+
+    def test_shipper_pin_tracks_cursor(self, tmp_path):
+        # policy "always": durable_tail tracks every append, so the
+        # whole history is shippable the moment the shipper starts
+        wal = WriteAheadLog(str(tmp_path / "wal"), policy="always",
+                            segment_max_bytes=64)
+        for i in range(6):
+            wal.append(i, [(SR_SET, 0, i)])
+        wal.reclaim_floor = 6
+        feed = DirectoryFeed(str(tmp_path / "feed"))
+        # attached but not yet shipping: the pin is at the resume
+        # cursor, so however far snapshot floor + GC head advanced,
+        # NOTHING unshipped can be reclaimed out from under the feed
+        s = ReplicationShipper(wal, feed, auto_start=False)
+        assert wal.pins() == {"ship": 0}
+        assert wal.maybe_reclaim(6) == 0
+        s.start()
+        s.barrier(6, timeout=10.0)
+        assert wal.pins()["ship"] == 6  # advanced only after publish
+        assert wal.maybe_reclaim(6) >= 1  # now reclaimable
+        assert feed.tail_pos() == 6
+        s.stop()
+        assert wal.pins() == {}  # stop releases the pin
+        wal.close()
+
+    def test_shipper_refuses_reclaimed_gap(self, tmp_path):
+        # feed at 0, WAL already reclaimed past it: the unshippable
+        # gap is a typed construction error, never silent data loss
+        wal = self._walled(tmp_path / "wal")
+        wal.maybe_reclaim(6)
+        assert wal.base > 0
+        feed = DirectoryFeed(str(tmp_path / "feed"))
+        with pytest.raises(ShipError, match="re-seed"):
+            ReplicationShipper(wal, feed, auto_start=False)
+        wal.close()
+
+
+# ---------------------------------------------------------------- shipper
+
+
+class _FakeHealth:
+    def __init__(self):
+        self.reported = []
+
+    def report_worker_exception(self, rid, exc=None):
+        self.reported.append((rid, exc))
+
+
+class TestShipper:
+    def test_ships_only_durable_records(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "wal"), policy="batch")
+        feed = DirectoryFeed(str(tmp_path / "feed"))
+        s = ReplicationShipper(wal, feed, poll_s=0.001)
+        try:
+            wal.append(0, [(SR_SET, 0, 1), (SR_SET, 1, 1)])
+            # nothing below durable_tail=0 is shippable: the feed
+            # must never hold an op the primary could still lose
+            with pytest.raises(ShipError, match="timed out"):
+                s.barrier(2, timeout=0.1)
+            assert feed.tail_pos() == 0
+            wal.sync()
+            s.barrier(2, timeout=10.0)
+            assert feed.tail_pos() == 2
+            assert feed.poll(0)[0].ops()[0] == (SR_SET, 0, 1, 0)
+            assert s.lag() == 0
+            assert s.stats()["published"] == 2
+        finally:
+            s.stop()
+            wal.close()
+
+    def test_ship_failure_surfaces(self, tmp_path, metrics_on):
+        # a dead shipper must never be silent: barrier callers get a
+        # typed ShipError (acks stop) and the health API hears it
+        wal = WriteAheadLog(str(tmp_path / "wal"), policy="batch")
+        health = _FakeHealth()
+        errors0 = get_registry().counter("repl.ship_errors").value
+        s = ReplicationShipper(
+            wal, feed=DirectoryFeed(str(tmp_path / "feed")),
+            poll_s=0.001, health=health, health_rid=0,
+            auto_start=False,
+        )
+        with FaultPlan([FaultSpec(site="ship",
+                                  action="raise")]).armed():
+            s.start()
+            with pytest.raises(ShipError) as ei:
+                s.barrier(1, timeout=10.0)
+        assert s.error is not None
+        assert ei.value.__cause__ is s.error
+        # barrier wakes on the error SLOT; the health report lands a
+        # beat later on the dying ship thread — join it first
+        s._thread.join(5.0)
+        assert health.reported and health.reported[0][0] == 0
+        assert get_registry().counter("repl.ship_errors").value \
+            == errors0 + 1
+        s.stop()
+        wal.close()
+
+    def test_heartbeat_beacon_changes(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "wal"), policy="batch")
+        feed = DirectoryFeed(str(tmp_path / "feed"))
+        s = ReplicationShipper(wal, feed, poll_s=0.001,
+                               heartbeat_interval_s=0.0)
+        try:
+            import time
+
+            deadline = 400
+            while feed.read_heartbeat() is None and deadline:
+                deadline -= 1
+                time.sleep(0.005)
+            first = feed.read_heartbeat()
+            assert first is not None
+            deadline = 400
+            while feed.read_heartbeat() == first and deadline:
+                deadline -= 1
+                time.sleep(0.005)
+            # the beacon keeps changing — the promotion watcher's
+            # liveness signal is CHANGE, not content
+            assert feed.read_heartbeat() != first
+        finally:
+            s.stop()
+            wal.close()
+
+
+# ----------------------------------------------------- follower (fleets)
+
+
+def _primary(tmp_path, clients=4):
+    nr = NodeReplicated(DISPATCH, **NR_KW)
+    wal = WriteAheadLog(str(tmp_path / "primary-wal"), policy="batch")
+    nr.attach_wal(wal)
+    feed = DirectoryFeed(str(tmp_path / "feed"),
+                         arg_width=nr.spec.arg_width)
+    shipper = ReplicationShipper(wal, feed, poll_s=0.001,
+                                 heartbeat_interval_s=0.01)
+    return nr, wal, feed, shipper
+
+
+class TestFollower:
+    def test_bit_identity_bounded_reads_and_not_primary(self, tmp_path):
+        nr, wal, feed, shipper = _primary(tmp_path)
+        tok = nr.register(0)
+        for i in range(1, 11):
+            for c in range(4):
+                nr.execute_mut((SR_SET, c, i), tok)
+        nr.wal_sync()
+        shipper.barrier(40, timeout=10.0)
+        f = Follower(DISPATCH, feed, str(tmp_path / "f1"),
+                     nr_kwargs=NR_KW)
+        try:
+            assert f.wait_applied(40, timeout=10.0)
+            # bit-identity at the common position: follower state IS
+            # the primary's fold (deterministic replay)
+            assert_states_equal(states_np(nr), f.nr.states)
+            # the applied history is re-journaled in the follower's
+            # OWN WAL (it can seed recovery or further followers)
+            assert f.nr.wal.tail == 40
+            # bounded-staleness read: lag 0 against a quiet feed
+            v, applied, bound = f.read_result((SR_GET, 2),
+                                              max_lag_pos=0,
+                                              wait_s=2.0)
+            assert v == 10
+            assert applied >= bound == 40
+            # a write belongs on the primary until promotion
+            with pytest.raises(NotPrimary):
+                f.frontend.submit((SR_SET, 0, 99))
+            # an unreachable bound rejects typed, never serves stale
+            with pytest.raises(StaleRead) as ei:
+                f.read((SR_GET, 0), min_pos=10_000, wait_s=0.01)
+            assert ei.value.min_pos == 10_000
+            assert ei.value.applied_pos >= 40
+        finally:
+            f.close()
+            shipper.stop()
+            nr.detach_wal().close()
+
+    def test_duplicate_overlap_and_gap(self, tmp_path):
+        import time
+
+        feed = DirectoryFeed(str(tmp_path / "feed"))
+        feed.publish(0, 0, *sets(0, [(0, 1), (1, 1)]))
+        f = Follower(DISPATCH, feed, str(tmp_path / "f"),
+                     nr_kwargs=NR_KW)
+        try:
+            assert f.wait_applied(2, timeout=10.0)
+            # exact duplicate delivery (shipper resume re-ship):
+            # filtered below the cursor, never re-applied
+            feed.publish(0, 0, *sets(0, [(0, 1), (1, 1)]))
+            time.sleep(0.05)
+            assert f.applied_pos() == 2
+            assert f.error is None
+            # a record STRADDLING the cursor applies only its suffix
+            feed.publish(0, 1, *sets(1, [(1, 1), (2, 1), (3, 1)]))
+            assert f.wait_applied(4, timeout=10.0)
+            tok = f.nr.register(0)
+            assert f.nr.execute((SR_GET, 2), tok) == 1
+            assert f.nr.execute((SR_GET, 1), tok) == 1  # not doubled
+            # out-of-order delivery (a gap): typed, position-carrying,
+            # and the apply thread reports rather than skipping
+            feed.publish(0, 50, *sets(50, [(0, 9)]))
+            deadline = 400
+            while f.error is None and deadline:
+                deadline -= 1
+                time.sleep(0.005)
+            assert isinstance(f.error, FeedGapError)
+            assert (f.error.expected, f.error.got) == (4, 50)
+            assert f.applied_pos() == 4  # nothing skipped
+        finally:
+            f.close()
+
+    def test_follower_boots_behind_a_fenced_feed(self, tmp_path):
+        # a feed fenced by a promotion still seeds fresh followers:
+        # the apply-side epoch floor tracks APPLIED records, not the
+        # fence file — pre-promotion history below the fence must
+        # apply, then the floor rises with the stream
+        feed = DirectoryFeed(str(tmp_path / "feed"))
+        feed.publish(0, 0, *sets(0, [(0, 1)]))
+        feed.fence(2)
+        feed.publish(2, 1, *sets(1, [(0, 2)]))
+        f = Follower(DISPATCH, feed, str(tmp_path / "f"),
+                     nr_kwargs=NR_KW)
+        try:
+            assert f.wait_applied(2, timeout=10.0)
+            assert f.error is None
+            assert f.epoch == 2
+            tok = f.nr.register(0)
+            assert f.nr.execute((SR_GET, 0), tok) == 2
+        finally:
+            f.close()
+
+    def test_apply_record_rules_dup_fence_gap(self, tmp_path,
+                                              metrics_on):
+        # the _apply_record cursor rules, driven directly (no apply
+        # thread): these defend the interleavings poll's start filter
+        # cannot — a record that slips below the cursor inside one
+        # poll batch, and a zombie epoch that chains correctly
+        from node_replication_tpu.repl.feed import FeedRecord
+
+        feed = DirectoryFeed(str(tmp_path / "feed"))
+        f = Follower(DISPATCH, feed, str(tmp_path / "f"),
+                     nr_kwargs=NR_KW, auto_start=False)
+
+        def rec(epoch, pos, pairs):
+            ops, args = sets(pos, pairs)
+            return FeedRecord(epoch, pos, ops, args)
+
+        try:
+            assert f._apply_record(rec(5, 0, [(0, 1), (1, 1)]))
+            assert f.applied_pos() == 2
+            assert f.epoch == 5  # epoch floor tracks applied records
+            # wholly-below-cursor duplicate: skipped, counted
+            dups0 = get_registry().counter(
+                "repl.duplicate_records").value
+            assert not f._apply_record(rec(5, 0, [(0, 1), (1, 1)]))
+            assert f.applied_pos() == 2
+            assert get_registry().counter(
+                "repl.duplicate_records").value == dups0 + 1
+            # a zombie primary's late record (older epoch) chains
+            # correctly by position — the epoch alone must fence it
+            fenced0 = get_registry().counter(
+                "repl.fenced_records").value
+            assert not f._apply_record(rec(3, 2, [(0, 99)]))
+            assert f.applied_pos() == 2
+            assert get_registry().counter(
+                "repl.fenced_records").value == fenced0 + 1
+            tok = f.nr.register(0)
+            assert f.nr.execute((SR_GET, 0), tok) == 1  # not 99
+            # the new epoch's records keep applying
+            assert f._apply_record(rec(5, 2, [(2, 1)]))
+            # a gap raises typed with both positions
+            with pytest.raises(FeedGapError) as ei:
+                f._apply_record(rec(5, 50, [(0, 9)]))
+            assert (ei.value.expected, ei.value.got) == (3, 50)
+        finally:
+            f.close()
+
+    def test_promotion_fences_drains_and_serves_writes(self, tmp_path):
+        nr, wal, feed, shipper = _primary(tmp_path)
+        tok = nr.register(0)
+        for i in range(1, 6):
+            for c in range(4):
+                nr.execute_mut((SR_SET, c, i), tok)
+        nr.wal_sync()
+        shipper.barrier(20, timeout=10.0)
+        f = Follower(DISPATCH, feed, str(tmp_path / "f1"),
+                     nr_kwargs=NR_KW, name="f1")
+        lagger = Follower(DISPATCH, feed, str(tmp_path / "f2"),
+                          nr_kwargs=NR_KW, name="f2",
+                          auto_start=False)
+        try:
+            assert f.wait_applied(20, timeout=10.0)
+            # primary "dies" with one batch shipped but un-applied
+            nr.execute_mut((SR_SET, 0, 6), tok)
+            nr.wal_sync()
+            shipper.barrier(21, timeout=10.0)
+            shipper.stop(clear_pin=False)
+            mgr = PromotionManager(feed, [f, lagger],
+                                   heartbeat_timeout_s=0.2,
+                                   check_interval_s=0.02)
+            # election picks the most-advanced live follower
+            assert mgr.elect() is f
+            report = mgr.promote_now(detect_s=0.1)
+            assert report.follower == "f1"
+            assert report.applied_pos == 21  # the backlog drained
+            assert report.rto_s == pytest.approx(
+                0.1 + report.promote_s)
+            assert f.promoted and not f.frontend.read_only
+            assert feed.epoch() == report.new_epoch
+            # zombie fencing at the transport: the dead primary's
+            # epoch can no longer extend the feed
+            with pytest.raises(EpochFencedError):
+                feed.publish(report.new_epoch - 1, 21,
+                             *sets(21, [(0, 99)]))
+            # durable-ack write serving resumed where acks ended
+            assert f.frontend.call((SR_SET, 0, 7), rid=0) == 6
+            assert f.frontend.read((SR_GET, 1), rid=0) == 5
+            assert f.nr.wal.durable_tail == 22
+        finally:
+            lagger.close()
+            f.close()
+            nr.detach_wal().close()
+
+
+# -------------------------------------------------------------- promotion
+
+
+class TestPromotionWatch:
+    def test_heartbeat_detection_quarantines_then_promotes(
+        self, tmp_path,
+    ):
+        import time
+
+        feed = DirectoryFeed(str(tmp_path / "feed"))
+        feed.publish(0, 0, *sets(0, [(0, 1)]))
+        f = Follower(DISPATCH, feed, str(tmp_path / "f"),
+                     nr_kwargs=NR_KW, name="f")
+        try:
+            assert f.wait_applied(1, timeout=10.0)
+            mgr = PromotionManager(feed, [f],
+                                   heartbeat_timeout_s=0.1,
+                                   check_interval_s=0.01)
+            # never-observed primary: silence alone must NOT fail
+            # over onto thin air
+            time.sleep(0.3)
+            assert mgr.run(timeout=0.3) is None
+            # a live primary beacons; then goes silent
+            feed.write_heartbeat("0 1 1")
+            assert mgr.run(timeout=0.05) is None  # observed, healthy
+            report = mgr.run(timeout=10.0)  # silence -> promotion
+            assert report is not None
+            assert report.follower == "f"
+            assert report.detect_s >= 0.1
+            assert report.rto_s == pytest.approx(
+                report.detect_s + report.promote_s)
+            assert mgr.report is report and mgr.wait(0.1) is report
+            assert f.promoted
+        finally:
+            f.close()
