@@ -1148,3 +1148,86 @@ def non_durable_publish(mod: ModuleInfo,
                 "file handle, fsync it, then os.replace into place "
                 "(core/checkpoint.py:save_snapshot is the template)",
             )
+
+
+# --------------------------------------------------------------------------
+# raw-clock-in-subsystem
+# --------------------------------------------------------------------------
+
+#: package directories whose timed waits must route through the
+#: injectable clock (the simulation contract, `utils/clock.py`)
+_CLOCKED_SUBSYSTEMS = ("serve", "fault", "repl", "durable")
+
+_RAW_CLOCK_CALLS = {
+    "time.monotonic": "time.monotonic() reads the OS clock directly",
+    "time.sleep": "time.sleep() blocks on the OS clock directly",
+}
+
+#: receiver tails that denote a threading.Condition in this codebase
+#: (`self._cond`, `self._lock`-as-Condition, a local `cond`); `clock`
+#: receivers are the sanctioned routing and never match
+_CONDITION_TOKENS = ("cond", "lock")
+
+
+def _clocked_subsystem(path: str) -> str | None:
+    parts = re.split(r"[\\/]+", path)
+    for name in _CLOCKED_SUBSYSTEMS:
+        if name in parts[:-1]:
+            return name
+    return None
+
+
+@rule(
+    "raw-clock-in-subsystem", WARNING,
+    "direct time.monotonic/time.sleep/Condition.wait in a "
+    "clock-routed subsystem (serve/, fault/, repl/, durable/)",
+)
+def raw_clock_in_subsystem(mod: ModuleInfo,
+                           project: Project) -> Iterator[Diagnostic]:
+    """The simulation contract (`utils/clock.py`, `sim/`): every timed
+    wait in serve/, fault/, repl/, and durable/ routes through the
+    process-global injectable clock — `get_clock().now()/.sleep()/
+    .wait(cond, timeout)` — so `SimClock` can substitute virtual time
+    and a seeded schedule fully determines which timeouts fire. A
+    direct `time.monotonic()`, `time.sleep()`, or `Condition.wait()`
+    in those packages is invisible to the simulator: the component
+    would block on (or stamp with) real time mid-simulation, and the
+    deterministic-replay property dies silently. `time.perf_counter()`
+    duration probes are exempt (pure intervals, no scheduling), as are
+    `Thread.join` and `Event.wait` (real-thread barriers). The raw
+    clock legitimately lives in `utils/clock.py` itself and in obs/
+    (whose wall/mono stamps are correlation fields) — both outside
+    this rule's path scope."""
+    sub = _clocked_subsystem(mod.path)
+    if sub is None:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = mod.dotted(node.func)
+        if dotted in _RAW_CLOCK_CALLS:
+            yield _diag(
+                mod, node, "raw-clock-in-subsystem",
+                f"{_RAW_CLOCK_CALLS[dotted]} inside {sub}/; route "
+                "through the injectable clock "
+                "(utils/clock.py:get_clock) so simulated runs stay "
+                "deterministic",
+            )
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "wait"):
+            continue
+        tail = _receiver_tail(fn.value)
+        if tail is None:
+            continue
+        low = tail.lower()
+        if any(tok in low for tok in _CONDITION_TOKENS) and (
+            "clock" not in low
+        ):
+            yield _diag(
+                mod, node, "raw-clock-in-subsystem",
+                f"direct Condition.wait on `{tail}` inside {sub}/; "
+                "use get_clock().wait(cond, timeout) so SimClock can "
+                "wake the waiter when virtual time passes its "
+                "deadline",
+            )

@@ -453,6 +453,10 @@ class WriteAheadLog:
 
     def _fsync_locked(self) -> None:
         fault_hook("wal-fsync", -1, self)
+        # perf_counter, not the injected clock: this is a pure
+        # duration probe around REAL disk I/O (the raw-clock rule's
+        # explicit exemption) — virtual time would make fsync span
+        # metrics meaningless under simulation
         t0 = time.perf_counter()
         try:
             self._fh.flush()

@@ -38,12 +38,12 @@ from __future__ import annotations
 
 import hashlib
 import threading
-import time
 from collections import Counter
 
 import numpy as np
 
 from node_replication_tpu.obs.metrics import get_registry
+from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer
 
 HEALTHY = "healthy"
@@ -138,7 +138,9 @@ class HealthTracker:
         self._stall_counts = [0] * n_replicas
         self.exc_threshold = exc_threshold
         self.stall_threshold = stall_threshold
-        #: every transition, in order: (monotonic_ts, rid, from, to)
+        #: every transition, in order: (clock_ts, rid, from, to) —
+        #: stamped with the injected clock (`utils/clock.py`;
+        #: real-monotonic by default, virtual under simulation)
         self.timeline: list[tuple[float, int, str, str]] = []
         reg = get_registry()
         self._m_quarantine = reg.counter("fault.quarantine")
@@ -179,7 +181,10 @@ class HealthTracker:
         if (frm, to) not in _LEGAL:
             raise IllegalTransition(rid, frm, to)
         self._states[rid] = to
-        self.timeline.append((time.monotonic(), rid, frm, to))
+        # injected clock, not time.monotonic(): under `SimClock`
+        # (`sim/`) lifecycle timelines — and obs/report.py's fault
+        # section built from them — carry meaningful virtual stamps
+        self.timeline.append((get_clock().now(), rid, frm, to))
         if to == QUARANTINED:
             self._m_quarantine.inc()
         get_tracer().emit("fault-transition", rid=rid, frm=frm, to=to)

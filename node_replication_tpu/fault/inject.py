@@ -46,9 +46,9 @@ from __future__ import annotations
 import dataclasses
 import random
 import threading
-import time
 
 from node_replication_tpu.obs.metrics import get_registry
+from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer
 
 # Every armable site, in hook order of the write path; the `wal-*`
@@ -245,7 +245,9 @@ class FaultPlan:
         if spec.action == "raise":
             raise FaultError(site, target)
         if spec.action == "stall":
-            time.sleep(spec.effective_stall_s)
+            # injected clock: under `SimClock` a stall is a virtual-
+            # time event (instant in wall time, visible in timelines)
+            get_clock().sleep(spec.effective_stall_s)
             return
         if spec.action == "corrupt-bytes":
             # flip a byte of the owner's last on-disk record (the

@@ -166,22 +166,27 @@ class ReplicaLifecycleManager:
         return minority
 
     def wait_idle(self, timeout: float | None = None) -> bool:
-        """Join outstanding medic threads. False on timeout."""
-        t_end = (
-            None if timeout is None else time.monotonic() + timeout
-        )
+        """Join outstanding medic threads. False on timeout.
+
+        Medics are REAL threads, so the budget is accounted in real
+        time by bounded join slices (a `Thread.join` is the rule's
+        real-thread-barrier exemption) — an injected-clock deadline
+        here would never fire under `SimClock`, turning a hung medic
+        into an unbounded wait. Each slice charges at most its own
+        length, so `timeout` bounds the total wall wait to within one
+        slice."""
+        remaining = None if timeout is None else float(timeout)
         while True:
             with self._lock:
                 medics = [t for t in self._medics if t.is_alive()]
                 self._medics = medics
             if not medics:
                 return True
-            rem = (
-                None if t_end is None
-                else max(0.0, t_end - time.monotonic())
-            )
-            medics[0].join(rem)
-            if t_end is not None and time.monotonic() >= t_end:
-                with self._lock:
-                    still = any(t.is_alive() for t in self._medics)
-                return not still
+            if remaining is None:
+                medics[0].join()
+                continue
+            if remaining <= 0:
+                return False
+            piece = min(remaining, 0.1)
+            medics[0].join(piece)
+            remaining -= piece

@@ -58,6 +58,7 @@ from node_replication_tpu.obs.metrics import get_registry
 from node_replication_tpu.repl.feed import FeedGapError
 from node_replication_tpu.serve.errors import StaleRead
 from node_replication_tpu.serve.frontend import ServeConfig, ServeFrontend
+from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer, span
 
 logger = logging.getLogger("node_replication_tpu")
@@ -177,7 +178,7 @@ class Follower:
             with self._cond:
                 if self._stop:
                     return
-                self._cond.wait(self._poll_s)
+                get_clock().wait(self._cond, self._poll_s)
 
     def _apply_once(self, drain: bool = False) -> int:
         """Poll the feed once and apply everything readable. Returns
@@ -275,19 +276,22 @@ class Follower:
                      timeout: float | None = None) -> bool:
         """Block until the applied cursor reaches `pos` (test/ops
         barrier). False on timeout or a dead apply thread."""
+        clock = get_clock()
         t_end = (
-            None if timeout is None else time.monotonic() + timeout
+            None if timeout is None else clock.now() + timeout
         )
         with self._cond:
             while self._applied < pos:
                 if self._error is not None or self._stop:
                     return False
                 rem = (
-                    None if t_end is None else t_end - time.monotonic()
+                    None if t_end is None else t_end - clock.now()
                 )
                 if rem is not None and rem <= 0:
                     return False
-                self._cond.wait(rem if rem is None else min(rem, 0.05))
+                clock.wait(
+                    self._cond, rem if rem is None else min(rem, 0.05)
+                )
             return True
 
     def stats(self) -> dict:

@@ -44,6 +44,7 @@ from node_replication_tpu.fault.health import (
     HealthTracker,
 )
 from node_replication_tpu.obs.metrics import get_registry
+from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer
 
 logger = logging.getLogger("node_replication_tpu")
@@ -100,7 +101,7 @@ class PromotionManager:
 
         self._lock = threading.Lock()
         self._last_hb: str | None = None
-        self._last_change = time.monotonic()
+        self._last_change = get_clock().now()
         # silence counts only once a primary has been OBSERVED: a
         # watcher armed before the primary finishes booting (or with
         # no primary at all) must not fail over onto thin air —
@@ -121,7 +122,7 @@ class PromotionManager:
         silence. Returns the primary's current health state; when the
         step quarantines the primary, the caller should promote
         (`run()`/the watch thread do so automatically)."""
-        now = time.monotonic()
+        now = get_clock().now()
         hb = self._feed.read_heartbeat()
         with self._lock:
             if hb is None and not self._seen:
@@ -185,8 +186,9 @@ class PromotionManager:
         """Watch until the primary dies, then promote; returns the
         report (None when `timeout` expires with the primary alive).
         The watch thread (`start()`) runs exactly this."""
+        clock = get_clock()
         t_end = (
-            None if timeout is None else time.monotonic() + timeout
+            None if timeout is None else clock.now() + timeout
         )
         while True:
             with self._lock:
@@ -195,15 +197,15 @@ class PromotionManager:
             state = self.check()
             if state == QUARANTINED:
                 with self._lock:
-                    silence = time.monotonic() - self._last_change
+                    silence = clock.now() - self._last_change
                 logger.warning(
                     "primary declared dead after %.2fs of heartbeat "
                     "silence; promoting", silence,
                 )
                 return self.promote_now(detect_s=silence)
-            if t_end is not None and time.monotonic() >= t_end:
+            if t_end is not None and clock.now() >= t_end:
                 return None
-            time.sleep(self.check_interval_s)
+            clock.sleep(self.check_interval_s)
 
     # --------------------------------------------------------- threaded
 

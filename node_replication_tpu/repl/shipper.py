@@ -36,10 +36,10 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 
 from node_replication_tpu.fault.inject import fault_hook
 from node_replication_tpu.obs.metrics import get_registry
+from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer
 
 logger = logging.getLogger("node_replication_tpu")
@@ -159,7 +159,7 @@ class ReplicationShipper:
                     return
                 if self._error is None and \
                         self._cursor >= self._wal.durable_tail:
-                    self._cond.wait(self.poll_s)
+                    get_clock().wait(self._cond, self.poll_s)
 
     def _ship_once(self) -> None:
         fault_hook("ship", -1, self)
@@ -195,7 +195,7 @@ class ReplicationShipper:
             self._maybe_heartbeat()
 
     def _maybe_heartbeat(self) -> None:
-        now = time.monotonic()
+        now = get_clock().now()
         if now < self._hb_due:
             return
         self._hb_due = now + self.heartbeat_interval_s
@@ -234,7 +234,8 @@ class ReplicationShipper:
         pos = int(pos)
         if timeout is None:
             timeout = self.barrier_timeout_s
-        t_end = time.monotonic() + timeout
+        clock = get_clock()
+        t_end = clock.now() + timeout
         with self._cond:
             self._cond.notify_all()  # kick the ship loop's poll wait
             while self._published < pos:
@@ -245,13 +246,13 @@ class ReplicationShipper:
                     ) from self._error
                 if self._stop:
                     raise ShipError("shipper stopped")
-                rem = t_end - time.monotonic()
+                rem = t_end - clock.now()
                 if rem <= 0:
                     raise ShipError(
                         f"ship barrier timed out after {timeout}s "
                         f"(published {self._published} < {pos})"
                     )
-                self._cond.wait(min(rem, 0.05))
+                clock.wait(self._cond, min(rem, 0.05))
 
     # ------------------------------------------------------------ state
 

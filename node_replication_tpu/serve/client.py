@@ -18,35 +18,54 @@ log and resubmitting could duplicate it.
 `DeadlineExceeded` and `FrontendClosed` are NOT retried here —
 deadline'd work is stale by definition and a closed frontend is
 permanent; both propagate to the caller.
+
+Two budgets bound a call, both enforced here:
+
+- `max_attempts` bounds total submissions (first try included);
+- `total_deadline_s` bounds total elapsed time ACROSS attempts — a
+  retry whose backoff would outlive the remaining budget re-raises
+  the transient error instead of sleeping into a guaranteed timeout
+  (so no backoff ever runs past the budget), each attempt's per-call
+  `timeout` is clamped to the remainder, and a budget found already
+  spent re-raises the LAST transient error rather than submitting an
+  op doomed to time out. Without it, per-attempt timeouts compose
+  into an unbounded worst case (`max_attempts × (timeout +
+  backoff)`), which is no deadline at all from the caller's point of
+  view.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import random
-import time
 
 from node_replication_tpu.serve.errors import Overloaded, ReplicaFailed
+from node_replication_tpu.utils.clock import get_clock
 
 
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
-    """Capped exponential backoff with full jitter.
+    """Capped exponential backoff with full jitter + a total budget.
 
     Attempt i (0-based) sleeps `uniform(0, min(base * 2**i, cap))` —
     the AWS "full jitter" schedule, which decorrelates a thundering
     herd of shed clients better than fixed backoff. `max_attempts`
     bounds total submissions (first try included); attempt
-    `max_attempts` re-raises the final `Overloaded`.
+    `max_attempts` re-raises the final `Overloaded`. `total_deadline_s`
+    (None = unbounded, the pre-budget behavior) is the wall budget for
+    the WHOLE call — attempts, backoffs, and result waits together.
     """
 
     max_attempts: int = 8
     base_backoff_s: float = 0.001
     max_backoff_s: float = 0.100
+    total_deadline_s: float | None = None
 
     def __post_init__(self):
         if self.max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
+        if self.total_deadline_s is not None and self.total_deadline_s <= 0:
+            raise ValueError("total_deadline_s must be > 0 (or None)")
 
     def backoff_s(self, attempt: int, rng: random.Random) -> float:
         cap = min(self.base_backoff_s * (2 ** attempt),
@@ -66,27 +85,58 @@ def call_with_retry(
 ):
     """Closed-loop `frontend.call` that retries `Overloaded` (with
     backoff) and retryable `ReplicaFailed` (with backoff AND a
-    re-route to a healthy replica). `on_shed(attempt, delay_s)`
-    (optional) observes each `Overloaded` rejection — the bench uses
-    it to count retries without threading state through. Returns the
-    op's response; re-raises the last transient error when the policy
-    is exhausted."""
+    re-route to a healthy replica), inside the policy's attempt and
+    total-deadline budgets. `on_shed(attempt, delay_s)` (optional)
+    observes each `Overloaded` rejection — the bench uses it to count
+    retries without threading state through. Returns the op's
+    response; re-raises the last transient error when either budget is
+    exhausted."""
     policy = policy or RetryPolicy()
     rng = rng or random.Random()
+    clock = get_clock()
+    t_end = (
+        None if policy.total_deadline_s is None
+        else clock.now() + policy.total_deadline_s
+    )
+    last_transient: Exception | None = None
     for attempt in range(policy.max_attempts):
+        eff_timeout = timeout
+        if t_end is not None:
+            rem = t_end - clock.now()
+            if rem <= 0 and last_transient is not None:
+                # the budget was spent while backing off (scheduler
+                # jitter can oversleep): submitting now would only
+                # reach a guaranteed TimeoutError — and the op might
+                # still execute, which a resubmitting caller could
+                # duplicate. Surface the known transient state.
+                raise last_transient
+            # per-attempt result wait never outlives the total budget
+            eff_timeout = rem if timeout is None else min(timeout, rem)
         try:
             return frontend.call(op, rid=rid, deadline_s=deadline_s,
-                                 timeout=timeout)
+                                 timeout=eff_timeout)
         except (Overloaded, ReplicaFailed) as e:
             if isinstance(e, ReplicaFailed) and e.maybe_executed:
                 # the op may already be in the log (it WILL replay;
                 # only its response was lost) — resubmitting could
                 # duplicate it, so exactly-once forbids auto-retry
                 raise
+            last_transient = e
             exhausted = attempt + 1 >= policy.max_attempts
             delay = (
                 0.0 if exhausted else policy.backoff_s(attempt, rng)
             )
+            if t_end is not None and not exhausted:
+                budget = t_end - clock.now()
+                if budget <= delay:
+                    # the total deadline budget is spent (or the drawn
+                    # backoff would outlive it): retrying could not
+                    # complete in time, so the budget exhausts the
+                    # policy exactly like the attempt cap does —
+                    # re-raise now instead of sleeping into a
+                    # guaranteed timeout
+                    exhausted = True
+                    delay = 0.0
             if isinstance(e, Overloaded) and on_shed is not None:
                 # the final, exhausted rejection is observed too —
                 # shed accounting must see every attempt
@@ -102,5 +152,5 @@ def call_with_retry(
                     if alt:
                         rid = alt[attempt % len(alt)]
             if delay > 0:
-                time.sleep(delay)
+                clock.sleep(delay)
     raise AssertionError("unreachable")  # pragma: no cover

@@ -78,6 +78,7 @@ from node_replication_tpu.serve.errors import (
     StaleRead,
 )
 from node_replication_tpu.serve.future import ServeFuture
+from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer
 
 logger = logging.getLogger("node_replication_tpu")
@@ -223,19 +224,20 @@ class _SubmissionQueue:
         Waits for the first op, then lingers up to `linger_s` for the
         batch to fill — unless a full batch is already queued or the
         queue is closing (drain fast)."""
+        clock = get_clock()
         with self._lock:
             while not self._items and not self._closed:
-                self._lock.wait()
+                clock.wait(self._lock)
             if not self._items:
                 return None  # closed and empty: worker exits
             if (linger_s > 0 and len(self._items) < max_ops
                     and not self._closed):
-                t_end = time.monotonic() + linger_s
+                t_end = clock.now() + linger_s
                 while len(self._items) < max_ops and not self._closed:
-                    rem = t_end - time.monotonic()
+                    rem = t_end - clock.now()
                     if rem <= 0:
                         break
-                    self._lock.wait(rem)
+                    clock.wait(self._lock, rem)
             n = min(max_ops, len(self._items))
             batch = [self._items.popleft() for _ in range(n)]
             self._in_service = n
@@ -255,17 +257,18 @@ class _SubmissionQueue:
 
     def wait_idle(self, timeout: float | None = None) -> bool:
         """Block until no op is queued or in service (drain barrier)."""
+        clock = get_clock()
         t_end = (
-            None if timeout is None else time.monotonic() + timeout
+            None if timeout is None else clock.now() + timeout
         )
         with self._lock:
             while self._items or self._in_service:
                 rem = (
-                    None if t_end is None else t_end - time.monotonic()
+                    None if t_end is None else t_end - clock.now()
                 )
                 if rem is not None and rem <= 0:
                     return False
-                self._lock.wait(rem)
+                clock.wait(self._lock, rem)
             return True
 
     def close(self, drain: bool) -> list[_Request]:
@@ -611,11 +614,12 @@ class ServeFrontend:
         flush barrier, not a shutdown."""
         with self._lock:  # grow() can resize the dict mid-iteration
             qs = list(self._queues.values())
+        clock = get_clock()
         t_end = (
-            None if timeout is None else time.monotonic() + timeout
+            None if timeout is None else clock.now() + timeout
         )
         for q in qs:
-            rem = None if t_end is None else t_end - time.monotonic()
+            rem = None if t_end is None else t_end - clock.now()
             if not q.wait_idle(rem):
                 return False
         return True
@@ -640,10 +644,11 @@ class ServeFrontend:
             req.future._reject(FrontendClosed("closed before service"))
         if timeout is None:
             timeout = self.cfg.drain_timeout_s
-        t_end = time.monotonic() + timeout
+        clock = get_clock()
+        t_end = clock.now() + timeout
         if started:
             for t in workers:
-                t.join(max(0.0, t_end - time.monotonic()))
+                t.join(max(0.0, t_end - clock.now()))
         # paused frontend (never started) or drain timeout: requests
         # may still sit in the queues — reject, never strand a future
         for _, q in queues:
@@ -686,7 +691,7 @@ class ServeFrontend:
             deadline_s = self.cfg.default_deadline_s
         deadline = (
             None if deadline_s is None
-            else time.monotonic() + deadline_s
+            else get_clock().now() + deadline_s
         )
         fut = ServeFuture(rid, deadline=deadline)
         try:
@@ -755,16 +760,17 @@ class ServeFrontend:
                     f"{type(self._nr).__name__} has no ltail "
                     f"accessor; bounded-staleness reads need it"
                 )
-            deadline = time.monotonic() + max(0.0, wait_s)
+            clock = get_clock()
+            deadline = clock.now() + max(0.0, wait_s)
             while True:
                 # locked cursor peek: an unlocked log read races the
                 # exec round's buffer donation (core/replica.ltail)
                 applied = ltail(rid)
                 if applied >= min_pos:
                     break
-                if time.monotonic() >= deadline:
+                if clock.now() >= deadline:
                     raise StaleRead(rid, applied, min_pos)
-                time.sleep(0.0002)
+                clock.sleep(0.0002)
         return self._nr.execute(op, token)
 
     def stats(self) -> dict:
@@ -846,7 +852,7 @@ class ServeFrontend:
                 raise
             q.batch_done(0, 0)
             raise _ReplicaDown(e, batch, maybe_executed=False) from e
-        now = time.monotonic()
+        now = get_clock().now()
         live: list[_Request] = []
         missed = 0
         for req in batch:

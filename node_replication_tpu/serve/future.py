@@ -6,11 +6,12 @@ path never uses). One request = one future = exactly one resolution —
 the frontend resolves it with the combiner response or rejects it with
 a typed error (`serve/errors.py`), never both, never twice.
 
-Memory ordering: `_resolve`/`_reject` write the payload under `_lock`
-and then set `_evt`; `result()` waits on `_evt` and reads the payload
-without the lock. The Event is the publication barrier, so the lockless
-read observes a fully-written payload (same idiom as
-`queue.Queue`/`concurrent.futures`).
+Memory ordering: `_resolve`/`_reject` write the payload under the
+condition's lock and publish with `notify_all`; `result()` waits on the
+same condition, so the woken read observes a fully-written payload
+(the `queue.Queue`/`concurrent.futures` idiom). Timed waits route
+through the injectable clock (`utils/clock.py`), so a simulated run
+(`sim/`) resolves result timeouts in virtual time.
 
 Done-callbacks run on the WORKER thread that resolves the future (or
 inline on the caller when added after resolution), so they must never
@@ -21,8 +22,9 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Any, Callable
+
+from node_replication_tpu.utils.clock import get_clock
 
 logger = logging.getLogger("node_replication_tpu")
 
@@ -31,13 +33,13 @@ class ServeFuture:
     """Write-once response slot for one submitted op."""
 
     __slots__ = (
-        "_lock", "_evt", "_value", "_exc", "_callbacks",
+        "_cond", "_done", "_value", "_exc", "_callbacks",
         "rid", "deadline", "t_submit", "t_done",
     )
 
     def __init__(self, rid: int, deadline: float | None = None):
-        self._lock = threading.Lock()
-        self._evt = threading.Event()
+        self._cond = threading.Condition()
+        self._done = False
         self._value: Any = None
         self._exc: BaseException | None = None
         self._callbacks: list[Callable[["ServeFuture"], None]] = []
@@ -46,20 +48,31 @@ class ServeFuture:
         #: absolute monotonic deadline (None = no deadline)
         self.deadline = deadline
         #: monotonic admission stamp (set by the frontend at enqueue)
-        self.t_submit = time.monotonic()
+        self.t_submit = get_clock().now()
         #: monotonic resolution stamp (None until done)
         self.t_done: float | None = None
 
     # ------------------------------------------------------------ caller API
 
     def done(self) -> bool:
-        return self._evt.is_set()
+        return self._done  # GIL-atomic flag read
+
+    def _wait_done(self, timeout: float | None) -> bool:
+        clock = get_clock()
+        t_end = None if timeout is None else clock.now() + timeout
+        with self._cond:
+            while not self._done:
+                rem = None if t_end is None else t_end - clock.now()
+                if rem is not None and rem <= 0:
+                    return False
+                clock.wait(self._cond, rem)
+            return True
 
     def result(self, timeout: float | None = None):
         """Block until resolved and return the response (or raise the
         typed rejection). `timeout` bounds THIS wait only — it is not
         the request deadline, which the frontend enforces queue-side."""
-        if not self._evt.wait(timeout):
+        if not self._wait_done(timeout):
             raise TimeoutError(
                 f"response still pending after {timeout}s "
                 f"(request deadline is enforced by the frontend)"
@@ -70,7 +83,7 @@ class ServeFuture:
 
     def exception(self, timeout: float | None = None) -> BaseException | None:
         """Block until resolved; return the rejection (None on success)."""
-        if not self._evt.wait(timeout):
+        if not self._wait_done(timeout):
             raise TimeoutError(f"response still pending after {timeout}s")
         return self._exc
 
@@ -90,8 +103,8 @@ class ServeFuture:
         logged and swallowed so one bad handler cannot kill the batch
         loop."""
         run_now = False
-        with self._lock:
-            if self._evt.is_set():
+        with self._cond:
+            if self._done:
                 run_now = True
             else:
                 self._callbacks.append(fn)
@@ -104,15 +117,16 @@ class ServeFuture:
         """Resolve exactly once; returns False if already resolved
         (late resolutions — e.g. a drain racing a deadline sweep — are
         dropped, first writer wins)."""
-        with self._lock:
-            if self._evt.is_set():
+        with self._cond:
+            if self._done:
                 return False
             self._value = value
             self._exc = exc
-            self.t_done = time.monotonic()
+            self.t_done = get_clock().now()
             cbs = self._callbacks
             self._callbacks = []
-            self._evt.set()
+            self._done = True
+            self._cond.notify_all()
         for fn in cbs:
             self._run_callback(fn)
         return True

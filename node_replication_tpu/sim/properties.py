@@ -1,0 +1,1016 @@
+"""Case generation + step interpreter + the property catalog.
+
+A **case** is one fully-seeded simulated scenario: a model, a wrapper
+(`NodeReplicated` or `MultiLogReplicated`), a flavor (which subsystem
+stack is under test), and a flat list of **steps** — the seeded
+schedule. The interpreter (`run_case`) executes the steps one quantum
+at a time on a single driver thread under an installed `SimClock`
+(background loops are stepped cooperatively: the WAL shipper's
+`_ship_once`, the follower's `_apply_once`, the promotion watcher's
+`check()`), records every observable outcome into an event log, and
+checks the run against a pure-numpy oracle (`sim/oracle.py`). The
+same seed always produces the same spec, the same events, and the
+same digest — `replay.py` rests on exactly this.
+
+Flavors:
+
+- ``wrapper`` — ops straight into the wrapper (`execute_mut_batch` /
+  `execute`), faults at the replay/append/read-sync sites, silent
+  corruption + divergence probe + repair-by-replay (NR, R=3).
+- ``serve``   — closed-loop ops through a `ServeFrontend`; NR runs
+  failover + the `ReplicaLifecycleManager` medic pipeline under
+  serve-batch/append kills; CNR runs the same fault plans with
+  failover off (typed rejections, worker survives).
+- ``crash``   — NR + attached WAL; seeded kill -9 (flush-to-OS, then
+  truncate the active segment to its last-fsynced size, plus an
+  optional torn-tail remainder) followed by `recover_fleet`.
+- ``repl``    — NR primary + WAL + `DirectoryFeed` + shipper +
+  follower + promotion watcher, all stepped as scheduler quanta;
+  seeded primary kill, heartbeat-silence detection in virtual time,
+  election, epoch fence, promotion, post-failover serving.
+
+Property catalog (each violation carries the property name):
+
+- ``resp-diff``          — an acked response differs from the oracle's
+  at the same logical position.
+- ``read-diff`` / ``fread-diff`` — a (bounded-staleness) read differs
+  from the oracle at the replica's applied position.
+- ``maybe-executed-honesty`` — a rejection that promised
+  `maybe_executed=False` for an op the log provably holds.
+- ``log-content``        — the ring's `[0, tail)` is not exactly the
+  acked op sequence (lost, duplicated, or reordered entries).
+- ``state-diff``         — final replica state is not bit-identical to
+  the oracle's arrays.
+- ``bit-identity``       — unfenced replicas disagree after sync.
+- ``divergence-detect``  — an injected corruption the digest vote
+  failed to name.
+- ``durable-ack-survival`` — a crash/promotion lost an op that was
+  fsync-acked (crash) or shipped-acked (repl).
+- ``staleness-bound``    — a bounded read served below its bound.
+- ``replication-gap``    — the follower observed a feed gap/corruption
+  (the reclaim-vs-ship protection failing).
+- ``zombie-unfenced``    — a superseded primary's shipper published
+  past the promotion fence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import random
+import shutil
+import tempfile
+
+import numpy as np
+
+from node_replication_tpu.sim.oracle import make_oracle
+from node_replication_tpu.sim.scheduler import SimScheduler
+from node_replication_tpu.utils.clock import SimClock, installed
+
+MODELS = ("hashmap", "stack", "queue", "seqreg")
+WRAPPERS = ("nr", "cnr")
+FLAVORS = ("wrapper", "serve", "crash", "repl")
+
+#: canonical sizes — fixed per model so a sweep's cases share compiled
+#: kernels (same shapes => jit cache hits; per-case cost stays low)
+MODEL_SIZES = {"hashmap": 32, "stack": 24, "queue": 12, "seqreg": 16}
+LOG_ENTRIES = 256
+GC_SLACK = 32
+#: tiny WAL segments in the repl flavor: rotation every few records,
+#: so snapshot-floor reclamation has something to delete and the
+#: reclaim-vs-ship pin protection is actually load-bearing
+REPL_SEGMENT_BYTES = 256
+CRASH_SEGMENT_BYTES = 1 << 10
+
+_WRITE_FAULT_SITES = {
+    "wrapper": ("replay", "append"),
+    "serve": ("serve-batch", "append"),
+}
+_FAULT_ACTIONS = ("raise", "stall")
+
+
+@dataclasses.dataclass
+class CaseSpec:
+    """One fully-seeded scenario (JSON-able; the shrinker edits
+    `steps`, everything else is fixed by the seed)."""
+
+    seed: int
+    model: str
+    wrapper: str  # "nr" | "cnr"
+    flavor: str  # "wrapper" | "serve" | "crash" | "repl"
+    n_replicas: int
+    nlogs: int  # cnr only (1 for nr)
+    steps: list
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CaseSpec":
+        return cls(**{f.name: d[f.name]
+                      for f in dataclasses.fields(cls)})
+
+
+@dataclasses.dataclass
+class Violation:
+    prop: str
+    step: int  # index into spec.steps (-1 = end-of-case check)
+    detail: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CaseResult:
+    spec: CaseSpec
+    violations: list
+    events: list
+    digest: str
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+# ==========================================================================
+# generation
+# ==========================================================================
+
+
+def _gen_write(rng: random.Random, model: str, size: int,
+               uniq: int) -> list:
+    """One mutating op; `uniq` tags payloads so every logged write is
+    distinguishable (the log-content property needs exactness)."""
+    if model == "hashmap":
+        if rng.random() < 0.75:
+            return [1, rng.randrange(size), uniq]  # HM_PUT
+        return [2, rng.randrange(size), 0]  # HM_REMOVE
+    if model == "stack":
+        if rng.random() < 0.6:
+            return [1, uniq, 0]  # ST_PUSH
+        return [2, 0, 0]  # ST_POP
+    if model == "queue":
+        if rng.random() < 0.6:
+            return [1, uniq, 0]  # Q_ENQ
+        return [2, 0, 0]  # Q_DEQ
+    if model == "seqreg":
+        return [1, rng.randrange(size), uniq]  # SR_SET
+    raise ValueError(model)
+
+
+def _gen_read(rng: random.Random, model: str, size: int) -> list:
+    if model == "hashmap":
+        return [1, rng.randrange(size), 0]  # HM_GET
+    if model in ("stack", "queue"):
+        return [rng.choice((1, 2)), 0, 0]  # PEEK/FRONT or LEN
+    if model == "seqreg":
+        return [1, rng.randrange(size), 0]  # SR_GET
+    raise ValueError(model)
+
+
+def generate_case(
+    seed: int,
+    models=MODELS,
+    wrappers=WRAPPERS,
+    flavors=FLAVORS,
+) -> CaseSpec:
+    """Derive one `CaseSpec` from `seed` (restricted to the given
+    models/wrappers/flavors — `explore.py` passes its CLI filters, and
+    `replay.py` must pass the SAME filters to reproduce a sweep's
+    case)."""
+    rng = random.Random(int(seed))
+    # the durability and replication planes are NR surfaces: with
+    # "nr" filtered out, those flavors are dropped from the pool
+    # rather than silently overriding the wrapper filter
+    pool = [f for f in flavors
+            if "nr" in wrappers or f in ("wrapper", "serve")]
+    flavor = rng.choice(pool or ["wrapper"])
+    if flavor in ("crash", "repl") or "cnr" not in wrappers:
+        wrapper = "nr"
+    else:
+        wrapper = rng.choice(
+            [w for w in ("nr", "nr", "cnr") if w in wrappers]
+        )
+    model = rng.choice(list(models))
+    nlogs = 1
+    if wrapper == "cnr" and model in ("hashmap", "seqreg"):
+        nlogs = rng.choice((1, 2))
+    with_corrupt = (
+        wrapper == "nr" and flavor == "wrapper" and rng.random() < 0.4
+    )
+    R = 3 if with_corrupt else 2
+    n = rng.randint(16, 36)
+    uniq = 1
+    steps: list = []
+
+    def w(fault=None):
+        nonlocal uniq
+        op = _gen_write(rng, model, MODEL_SIZES[model], uniq)
+        uniq += 1
+        rid = rng.randrange(R)
+        if fault is None:
+            steps.append(["w", rid, op])
+        else:
+            steps.append(["wf", rid, fault[0], fault[1], op])
+
+    def r():
+        steps.append(
+            ["r", rng.randrange(R),
+             _gen_read(rng, model, MODEL_SIZES[model])]
+        )
+
+    if flavor in ("wrapper", "serve"):
+        kills = 0
+        for _ in range(n):
+            x = rng.random()
+            if x < 0.55:
+                w()
+            elif x < 0.75:
+                r()
+            elif x < 0.85 and kills < 2:
+                kills += 1
+                w(fault=(rng.choice(_WRITE_FAULT_SITES[flavor]),
+                         rng.choice(_FAULT_ACTIONS)))
+            elif x < 0.92 and flavor == "wrapper":
+                steps.append(["rf", rng.randrange(R),
+                              _gen_read(rng, model,
+                                        MODEL_SIZES[model])])
+            elif with_corrupt:
+                steps.append(["corrupt", rng.randrange(R)])
+                steps.append(["probe"])
+            else:
+                w()
+        steps.append(["sync"])
+        return CaseSpec(seed, model, wrapper, flavor, R, nlogs, steps)
+
+    if flavor == "crash":
+        crashes = 0
+        for i in range(n):
+            x = rng.random()
+            if x < 0.5:
+                w()
+            elif x < 0.62:
+                r()
+            elif x < 0.78:
+                steps.append(["wal-sync"])
+            elif x < 0.86:
+                steps.append(["snapshot"])
+            elif crashes < 2 and i > 4:
+                crashes += 1
+                # lose: drop everything past the last fsync; extra:
+                # torn-tail remainder bytes kept past that point
+                steps.append(["crash", int(rng.random() < 0.6),
+                              rng.randrange(64)])
+            else:
+                w()
+        steps.append(["sync"])
+        return CaseSpec(seed, model, wrapper, flavor, R, nlogs, steps)
+
+    # repl: weave the client/durability/ship/apply/watch lanes with a
+    # seeded cooperative scheduler — the schedule IS the interleaving
+    sched = SimScheduler(seed=rng.randrange(1 << 30))
+    sched.add("w", lambda: w() or True, weight=3.0)
+    sched.add("r", lambda: r() or True, weight=1.0)
+    sched.add("wal-sync", lambda: steps.append(["wal-sync"]) or True,
+              weight=1.2)
+    sched.add("ship", lambda: steps.append(["ship"]) or True,
+              weight=1.2)
+    sched.add("apply", lambda: steps.append(["apply"]) or True,
+              weight=1.2)
+    sched.add("fread", lambda: steps.append(
+        ["fread", _gen_read(rng, model, MODEL_SIZES[model]),
+         rng.choice((2, 4, 8))]) or True, weight=0.8)
+    sched.add("watch", lambda: steps.append(["watch", 1]) or True,
+              weight=0.5)
+    sched.run(n + 10)
+    # reclamation pressure mid-stream: snapshot raises the floor, the
+    # sync right after advances the GC head past it — only the ship
+    # pin now protects unshipped segments (the reclaim-vs-ship race
+    # the canary re-opens)
+    cut = rng.randrange(len(steps) // 2, len(steps))
+    steps[cut:cut] = [["snapshot"], ["sync"]]
+    if rng.random() < 0.7:
+        steps.append(["wal-sync"])
+        if rng.random() < 0.7:
+            steps.append(["ship"])
+        steps.append(["kill"])
+        for _ in range(9):
+            steps.append(["watch", 2])  # 2 virtual ticks per quantum
+        steps.append(["promote"])
+        if rng.random() < 0.5:
+            steps.append(["zombie-ship"])
+        for _ in range(rng.randrange(2, 6)):
+            op = _gen_write(rng, model, MODEL_SIZES[model], uniq)
+            uniq += 1
+            steps.append(["w", 0, op])
+        steps.append(["fread",
+                      _gen_read(rng, model, MODEL_SIZES[model]), 0])
+    else:
+        steps += [["wal-sync"], ["ship"], ["apply"], ["apply"]]
+    return CaseSpec(seed, model, wrapper, flavor, R, nlogs, steps)
+
+
+# ==========================================================================
+# interpretation
+# ==========================================================================
+
+
+def _make_dispatch(model: str):
+    from node_replication_tpu.models import (
+        make_hashmap,
+        make_queue,
+        make_seqreg,
+        make_stack,
+    )
+
+    maker = {"hashmap": make_hashmap, "stack": make_stack,
+             "queue": make_queue, "seqreg": make_seqreg}[model]
+    return maker(MODEL_SIZES[model])
+
+
+def _key_mapper(opcode, args):
+    return args[0]
+
+
+def _digest(spec: CaseSpec, events: list) -> str:
+    blob = json.dumps([spec.as_dict(), events], sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+class _Run:
+    """Mutable interpreter state for one case (one driver thread)."""
+
+    def __init__(self, spec: CaseSpec):
+        self.spec = spec
+        self.dispatch = _make_dispatch(spec.model)
+        self.oracle = make_oracle(spec.model, MODEL_SIZES[spec.model])
+        self.events: list = []
+        self.violations: list = []
+        self.applied: list = []  # ops in log order (host ground truth)
+        self.tokens: dict = {}
+        self.tmp: str | None = None
+        # flavor plumbing, filled by _build
+        self.wr = None
+        self.fe = None
+        self.mgr = None
+        self.wal = None
+        self.synced_sizes: dict = {}
+        self.feed = None
+        self.shipper = None
+        self.follower = None
+        self.pm = None
+        self.oracle_f = None
+        self.fpos = 0
+        self.primary_dead = False
+        self.promoted = False
+        self.shipped_acked = 0
+        self.pre_kill_cursor = 0
+
+    # ------------------------------------------------------------ plumbing
+
+    def ev(self, i: int, kind: str, **kv) -> None:
+        self.events.append([i, kind, kv])
+
+    def vio(self, prop: str, i: int, detail: str) -> None:
+        self.violations.append(Violation(prop, i, detail))
+
+    def tail(self) -> int:
+        if self.spec.wrapper == "cnr":
+            return int(np.asarray(self.wr.ml.tail).sum())
+        return int(np.asarray(self.wr.log.tail))
+
+    def token(self, rid: int):
+        if rid not in self.tokens:
+            self.tokens[rid] = self.wr.register(rid)
+        return self.tokens[rid]
+
+    def _build(self):
+        from node_replication_tpu.core.cnr import MultiLogReplicated
+        from node_replication_tpu.core.replica import NodeReplicated
+
+        spec = self.spec
+        if spec.wrapper == "cnr":
+            self.wr = MultiLogReplicated(
+                self.dispatch, _key_mapper, nlogs=spec.nlogs,
+                n_replicas=spec.n_replicas, log_entries=LOG_ENTRIES,
+                gc_slack=GC_SLACK,
+            )
+        else:
+            self.wr = NodeReplicated(
+                self.dispatch, n_replicas=spec.n_replicas,
+                log_entries=LOG_ENTRIES, gc_slack=GC_SLACK,
+            )
+        if spec.flavor in ("crash", "repl"):
+            from node_replication_tpu.durable.wal import WriteAheadLog
+
+            self.tmp = tempfile.mkdtemp(prefix="nr-sim-")
+            seg = (CRASH_SEGMENT_BYTES if spec.flavor == "crash"
+                   else REPL_SEGMENT_BYTES)
+            self.wal = WriteAheadLog(
+                os.path.join(self.tmp, "wal"), policy="batch",
+                arg_width=self.dispatch.arg_width,
+                segment_max_bytes=seg,
+            )
+            self.wr.attach_wal(self.wal)
+        if spec.flavor == "serve":
+            from node_replication_tpu.serve.frontend import (
+                ServeConfig,
+                ServeFrontend,
+            )
+
+            failover = spec.wrapper == "nr"
+            self.fe = ServeFrontend(
+                self.wr,
+                ServeConfig(batch_linger_s=0.0, queue_depth=64,
+                            failover=failover),
+            )
+            if failover:
+                from node_replication_tpu.fault.repair import (
+                    ReplicaLifecycleManager,
+                )
+
+                self.mgr = ReplicaLifecycleManager(self.wr, self.fe)
+        if spec.flavor == "wrapper" and spec.wrapper == "nr":
+            from node_replication_tpu.fault.repair import (
+                ReplicaLifecycleManager,
+            )
+
+            self.mgr = ReplicaLifecycleManager(self.wr)
+        if spec.flavor == "repl":
+            from node_replication_tpu.repl.feed import DirectoryFeed
+            from node_replication_tpu.repl.follower import Follower
+            from node_replication_tpu.repl.promote import (
+                PromotionManager,
+            )
+            from node_replication_tpu.repl.shipper import (
+                ReplicationShipper,
+            )
+            from node_replication_tpu.serve.frontend import ServeConfig
+
+            self.feed = DirectoryFeed(
+                os.path.join(self.tmp, "feed"),
+                arg_width=self.dispatch.arg_width,
+            )
+            self.shipper = ReplicationShipper(
+                self.wal, self.feed, auto_start=False,
+            )
+            self.follower = Follower(
+                self.dispatch, self.feed,
+                directory=os.path.join(self.tmp, "flw"),
+                config=ServeConfig(durability="batch",
+                                   batch_linger_s=0.0),
+                auto_start=False,
+                nr_kwargs={"n_replicas": 1,
+                           "log_entries": LOG_ENTRIES,
+                           "gc_slack": GC_SLACK},
+            )
+            self.pm = PromotionManager(
+                self.feed, [self.follower],
+                heartbeat_timeout_s=0.5, check_interval_s=0.1,
+            )
+            self.oracle_f = make_oracle(self.spec.model,
+                                        MODEL_SIZES[self.spec.model])
+
+    def _teardown(self):
+        if self.fe is not None:
+            self.fe.close()
+        if self.mgr is not None:
+            self.mgr.wait_idle(30)
+        if self.follower is not None:
+            self.follower.close()
+        if self.shipper is not None and self.wal is not None:
+            try:
+                self.wal.clear_pin("ship")
+            except Exception:
+                pass
+        if self.tmp is not None:
+            shutil.rmtree(self.tmp, ignore_errors=True)
+
+    # ------------------------------------------------------------- helpers
+
+    def _one_shot_plan(self, site: str, action: str):
+        from node_replication_tpu.fault.inject import (
+            FaultPlan,
+            FaultSpec,
+        )
+
+        return FaultPlan(
+            [FaultSpec(site=site, action=action, rid=-1, after=0)],
+            seed=self.spec.seed,
+        )
+
+    def _record_applied(self, op: list) -> None:
+        self.oracle.apply(op)
+        self.applied.append(list(op))
+
+    def _advance_oracle_f(self, to: int, i: int) -> None:
+        """Fold the follower's oracle up to applied position `to`."""
+        if to > len(self.applied):
+            self.vio("replication-gap", i,
+                     f"follower applied {to} > primary history "
+                     f"{len(self.applied)}")
+            to = len(self.applied)
+        for op in self.applied[self.fpos:to]:
+            self.oracle_f.apply(op)
+        self.fpos = max(self.fpos, to)
+
+    # ----------------------------------------------------------- op steps
+
+    def _write_target(self):
+        """(callable, kind) for the current write path."""
+        if self.spec.flavor == "serve" or self.promoted:
+            fe = (self.follower.frontend if self.promoted
+                  else self.fe)
+
+            def call(op, rid):
+                return fe.submit(tuple(op), rid=rid).result()
+
+            return call
+
+        def call(op, rid):
+            return self.wr.execute_mut_batch([tuple(op)], rid)[0]
+
+        return call
+
+    def do_write(self, i: int, rid: int, op: list,
+                 fault: tuple | None = None) -> None:
+        if self.spec.flavor == "repl" and self.primary_dead \
+                and not self.promoted:
+            self.ev(i, "w-unavailable")
+            return
+        if self.promoted:
+            rid = 0  # the follower fleet serves one replica
+        wr = self.follower.nr if self.promoted else self.wr
+        tail0 = (int(np.asarray(wr.log.tail))
+                 if self.spec.wrapper == "nr" or self.promoted
+                 else self.tail())
+        call = self._write_target()
+        err = None
+        try:
+            if fault is not None:
+                with self._one_shot_plan(*fault).armed():
+                    resp = call(op, rid)
+            else:
+                resp = call(op, rid)
+        except Exception as e:  # typed edges + injected faults
+            err = e
+        if err is not None:
+            if self.mgr is not None:
+                self.mgr.wait_idle(30)
+            tail1 = (int(np.asarray(wr.log.tail))
+                     if self.spec.wrapper == "nr" or self.promoted
+                     else self.tail())
+            applied_now = tail1 > tail0
+            from node_replication_tpu.serve.errors import ReplicaFailed
+
+            if (isinstance(err, ReplicaFailed)
+                    and not err.maybe_executed and applied_now):
+                self.vio(
+                    "maybe-executed-honesty", i,
+                    f"maybe_executed=False but the log advanced "
+                    f"{tail0}->{tail1}",
+                )
+            if applied_now:
+                # the op reached the log; only its response was lost.
+                # It replays LAZILY (the next combine/sync round), so
+                # force the round to completion before the oracle
+                # folds it — otherwise a later read legally observes
+                # the pre-op state and the differential would flag
+                # correct behavior
+                wr.sync()
+                self._record_applied(op)
+            self.ev(i, "w-err", err=type(err).__name__,
+                    applied=int(applied_now))
+            return
+        expect = self.oracle.apply(op)
+        self.applied.append(list(op))
+        if int(resp) != int(expect):
+            self.vio("resp-diff", i,
+                     f"op {op} -> {int(resp)}, oracle {int(expect)}")
+        self.ev(i, "w", resp=int(resp))
+
+    def do_read(self, i: int, rid: int, op: list,
+                fault: tuple | None = None) -> None:
+        if self.spec.flavor == "repl" and (self.primary_dead
+                                           and not self.promoted):
+            self.ev(i, "r-unavailable")
+            return
+        try:
+            if self.promoted:
+                val = self.follower.frontend.read(tuple(op), rid=0)
+            elif self.fe is not None:
+                val = self.fe.read(tuple(op), rid=rid)
+            else:
+                if fault is not None:
+                    with self._one_shot_plan(*fault).armed():
+                        val = self.wr.execute(tuple(op),
+                                              self.token(rid))
+                else:
+                    val = self.wr.execute(tuple(op), self.token(rid))
+        except Exception as e:
+            self.ev(i, "r-err", err=type(e).__name__)
+            return
+        expect = self.oracle.read(op)
+        if int(val) != int(expect):
+            self.vio("read-diff", i,
+                     f"read {op} on r{rid} -> {int(val)}, "
+                     f"oracle {int(expect)}")
+        self.ev(i, "r", val=int(val))
+
+    # -------------------------------------------------------- fault steps
+
+    def do_corrupt(self, i: int, rid: int) -> None:
+        from node_replication_tpu.fault.inject import corrupt_states
+
+        if self.spec.wrapper != "nr":
+            self.ev(i, "corrupt-skip")
+            return
+        self.wr.states = corrupt_states(self.wr.states, rid,
+                                        seed=self.spec.seed)
+        self._corrupted = rid
+        self.ev(i, "corrupt", rid=rid)
+
+    def do_probe(self, i: int) -> None:
+        if self.mgr is None or self.spec.wrapper != "nr":
+            self.ev(i, "probe-skip")
+            return
+        named = self.mgr.probe()
+        rid = getattr(self, "_corrupted", None)
+        if rid is not None:
+            if rid not in named:
+                self.vio("divergence-detect", i,
+                         f"corrupted r{rid} not named by the vote "
+                         f"(named {named})")
+            self._corrupted = None
+        self.ev(i, "probe", named=[int(x) for x in named])
+
+    # ------------------------------------------------------ durable steps
+
+    def do_wal_sync(self, i: int) -> None:
+        if self.wal is None or self.primary_dead:
+            self.ev(i, "wal-sync-skip")
+            return
+        pos = self.wr.wal_sync()
+        if self.wal._segments:
+            path = self.wal._segments[-1][1]
+            self.synced_sizes[path] = os.path.getsize(path)
+        self.ev(i, "wal-sync", durable=int(pos))
+
+    def do_snapshot(self, i: int) -> None:
+        from node_replication_tpu.durable.recovery import (
+            save_durable_snapshot,
+        )
+
+        if self.wal is None or self.primary_dead:
+            self.ev(i, "snapshot-skip")
+            return
+        save_durable_snapshot(self.wr, self.tmp)
+        self.ev(i, "snapshot", pos=len(self.applied))
+
+    def do_crash(self, i: int, lose: int, extra: int) -> None:
+        """Simulated kill -9 + restart: what the OS page cache held
+        survives (flush), anything after the last fsync optionally
+        does not (truncate to the recorded fsynced size, plus an
+        `extra`-byte torn remainder for the recovery scan to chop)."""
+        from node_replication_tpu.durable.recovery import recover_fleet
+
+        if self.wal is None:
+            self.ev(i, "crash-skip")
+            return
+        durable = self.wal.durable_tail
+        with self.wal._lock:
+            if self.wal._fh is not None:
+                self.wal._fh.flush()
+        if lose and self.wal._segments:
+            path = self.wal._segments[-1][1]
+            cur = os.path.getsize(path)
+            base = self.synced_sizes.get(path, 0)
+            keep = min(cur, base + (int(extra) % 64))
+            os.truncate(path, keep)
+        with self.wal._lock:
+            if self.wal._fh is not None:
+                self.wal._fh.close()
+                self.wal._fh = None
+        # the old wrapper is the corpse; recover from disk
+        nr2, report = recover_fleet(
+            self.tmp, self.dispatch, policy="batch", attach=True,
+            nr_kwargs={"n_replicas": self.spec.n_replicas,
+                       "log_entries": LOG_ENTRIES,
+                       "gc_slack": GC_SLACK},
+        )
+        T = int(report.tail)
+        if T < durable:
+            self.vio("durable-ack-survival", i,
+                     f"recovered tail {T} < fsync-acked {durable}")
+        if T > len(self.applied):
+            self.vio("log-content", i,
+                     f"recovered tail {T} > ops ever applied "
+                     f"{len(self.applied)}")
+            T = len(self.applied)
+        self.applied = self.applied[:T]
+        self.oracle = make_oracle(self.spec.model,
+                                  MODEL_SIZES[self.spec.model])
+        for op in self.applied:
+            self.oracle.apply(op)
+        self.wr = nr2
+        self.wal = nr2.wal
+        self.tokens = {}
+        self.synced_sizes = {}
+        if self.wal._segments:
+            path = self.wal._segments[-1][1]
+            self.synced_sizes[path] = os.path.getsize(path)
+        state = nr2.verify(lambda s: s)
+        self._check_arrays(state, self.oracle, i)
+        self.ev(i, "crash", recovered=T, durable=int(durable),
+                lost=int(lose))
+
+    # --------------------------------------------------------- repl steps
+
+    def do_ship(self, i: int, zombie: bool = False) -> None:
+        from node_replication_tpu.repl.feed import EpochFencedError
+
+        if self.shipper is None:
+            self.ev(i, "ship-skip")
+            return
+        if not zombie and (self.primary_dead or self.promoted):
+            self.ev(i, "ship-skip")
+            return
+        cur0 = self.shipper.cursor
+        try:
+            self.shipper._ship_once()
+        except EpochFencedError:
+            self.ev(i, "ship-fenced")
+            return
+        except Exception as e:
+            self.vio("replication-gap", i,
+                     f"ship failed: {type(e).__name__}: {e}")
+            return
+        if zombie and self.shipper.cursor > self.pre_kill_cursor:
+            self.vio("zombie-unfenced", i,
+                     f"superseded shipper published "
+                     f"{self.pre_kill_cursor}->{self.shipper.cursor} "
+                     f"past the promotion fence")
+        self.ev(i, "ship", shipped=int(self.shipper.cursor - cur0),
+                cursor=int(self.shipper.cursor))
+
+    def do_apply(self, i: int) -> None:
+        if self.follower is None or self.promoted:
+            self.ev(i, "apply-skip")
+            return
+        try:
+            n = self.follower._apply_once()
+        except Exception as e:
+            self.vio("replication-gap", i,
+                     f"follower apply failed: "
+                     f"{type(e).__name__}: {e}")
+            return
+        ap = self.follower.applied_pos()
+        self._advance_oracle_f(ap, i)
+        self.ev(i, "apply", records=int(n), applied=int(ap))
+
+    def do_fread(self, i: int, op: list, max_lag: int) -> None:
+        from node_replication_tpu.serve.errors import StaleRead
+
+        if self.follower is None:
+            self.ev(i, "fread-skip")
+            return
+        try:
+            val, applied, bound = self.follower.read_result(
+                tuple(op), rid=0, max_lag_pos=int(max_lag),
+                wait_s=0.0,
+            )
+        except StaleRead as e:
+            self.ev(i, "fread-stale", applied=int(e.applied_pos),
+                    bound=int(e.min_pos))
+            return
+        except Exception as e:
+            self.ev(i, "fread-err", err=type(e).__name__)
+            return
+        if applied < bound:
+            self.vio("staleness-bound", i,
+                     f"read served at {applied} below bound {bound}")
+        self._advance_oracle_f(self.follower.applied_pos(), i)
+        expect = self.oracle_f.read(op)
+        if int(val) != int(expect):
+            self.vio("fread-diff", i,
+                     f"follower read {op} -> {int(val)}, oracle "
+                     f"{int(expect)} at {self.follower.applied_pos()}")
+        self.ev(i, "fread", val=int(val), applied=int(applied),
+                bound=int(bound))
+
+    def do_watch(self, i: int, ticks: int, clock: SimClock) -> None:
+        if self.pm is None:
+            self.ev(i, "watch-skip")
+            return
+        clock.advance(0.1 * int(ticks))
+        state = self.pm.check()
+        self.ev(i, "watch", state=state)
+
+    def do_kill(self, i: int) -> None:
+        if self.shipper is None or self.primary_dead:
+            self.ev(i, "kill-skip")
+            return
+        self.primary_dead = True
+        self.pre_kill_cursor = int(self.shipper.cursor)
+        self.shipped_acked = min(int(self.wal.durable_tail),
+                                 self.pre_kill_cursor)
+        self.ev(i, "kill", durable=int(self.wal.durable_tail),
+                shipped=self.pre_kill_cursor,
+                acked=self.shipped_acked)
+
+    def do_promote(self, i: int) -> None:
+        from node_replication_tpu.fault.health import QUARANTINED
+
+        if self.follower is None or self.promoted:
+            self.ev(i, "promote-skip")
+            return
+        try:
+            if (self.pm is not None
+                    and self.pm.health.state(self.pm.health_rid)
+                    == QUARANTINED):
+                rep = self.pm.promote_now(detect_s=0.0)
+                applied = int(rep.applied_pos)
+                epoch = int(rep.new_epoch)
+                detected = 1
+            else:
+                rep = self.follower.promote()
+                applied = int(rep["applied"])
+                epoch = int(rep["epoch"])
+                detected = 0
+        except Exception as e:
+            self.vio("replication-gap", i,
+                     f"promotion failed: {type(e).__name__}: {e}")
+            return
+        self.promoted = True
+        if applied < self.shipped_acked:
+            self.vio("durable-ack-survival", i,
+                     f"promoted follower applied {applied} < "
+                     f"shipped-acked {self.shipped_acked}")
+        self._advance_oracle_f(applied, i)
+        # the follower's history is now the authority: the dead
+        # primary's unshipped suffix is legally gone
+        self.applied = self.applied[:applied]
+        self.fpos = min(self.fpos, applied)
+        self.oracle = self.oracle_f
+        self.ev(i, "promote", applied=applied, epoch=epoch,
+                detected=detected)
+
+    # ---------------------------------------------------------- end state
+
+    def _check_arrays(self, state, oracle, i: int,
+                      prop: str = "state-diff") -> None:
+        import jax
+
+        expect = oracle.arrays()
+        leaves = {}
+        if isinstance(state, dict):
+            leaves = state
+        else:  # pytree fallback
+            leaves = {str(k): v for k, v in
+                      enumerate(jax.tree.leaves(state))}
+        for name, arr in expect.items():
+            if name not in leaves:
+                self.vio(prop, i, f"state leaf {name!r} missing")
+                continue
+            got = np.asarray(leaves[name])
+            if got.shape != arr.shape or not np.array_equal(
+                    got, np.asarray(arr, got.dtype)):
+                self.vio(
+                    prop, i,
+                    f"state leaf {name!r} diverges from the oracle "
+                    f"(got {got.tolist()!r:.120s} want "
+                    f"{np.asarray(arr).tolist()!r:.120s})",
+                )
+
+    def _check_ring(self, nr, expect_ops: list, i: int) -> None:
+        from node_replication_tpu.core.log import ring_slice
+
+        tail = int(np.asarray(nr.log.tail))
+        if tail != len(expect_ops):
+            self.vio("log-content", i,
+                     f"log tail {tail} != acked op count "
+                     f"{len(expect_ops)}")
+            return
+        if tail == 0:
+            return
+        opcodes, args = ring_slice(nr.spec, nr.log, 0, tail)
+        aw = args.shape[1]
+        for k, op in enumerate(expect_ops):
+            want = [int(op[0])] + [int(x) for x in op[1:1 + aw]]
+            want += [0] * (1 + aw - len(want))
+            got = [int(opcodes[k])] + [int(x) for x in args[k]]
+            if got != want:
+                self.vio("log-content", i,
+                         f"log[{k}] = {got} != acked {want}")
+                return
+
+    def finalize(self) -> None:
+        spec = self.spec
+        if spec.flavor == "repl":
+            if not self.promoted and not self.primary_dead:
+                # drain: finish shipping/applying what is already
+                # durable so the follower checks run at a fixed point
+                for _ in range(4):
+                    self.do_wal_sync(-1)
+                    self.do_ship(-1)
+                    self.do_apply(-1)
+            if self.promoted:
+                self.follower.nr.sync()
+                self._check_arrays(
+                    self.follower.nr.verify(lambda s: s),
+                    self.oracle, -1)
+                self._check_ring(self.follower.nr, self.applied, -1)
+            else:
+                self.wr.sync()
+                if not self.wr.replicas_equal():
+                    self.vio("bit-identity", -1,
+                             "replicas disagree after sync")
+                self._check_arrays(self.wr.verify(lambda s: s),
+                                   self.oracle, -1)
+                self._check_ring(self.wr, self.applied, -1)
+                ap = self.follower.applied_pos()
+                self._advance_oracle_f(ap, -1)
+                self.follower.nr.sync()
+                self._check_arrays(
+                    self.follower.nr.verify(lambda s: s),
+                    self.oracle_f, -1)
+                self._check_ring(self.follower.nr,
+                                 self.applied[:ap], -1)
+            return
+        if self.fe is not None:
+            self.fe.close()
+            self.fe = None
+        self.wr.sync()
+        if not self.wr.replicas_equal():
+            self.vio("bit-identity", -1,
+                     "replicas disagree after sync")
+        self._check_arrays(self.wr.verify(lambda s: s), self.oracle,
+                           -1)
+        if spec.wrapper == "nr":
+            self._check_ring(self.wr, self.applied, -1)
+
+
+def run_case(spec: CaseSpec) -> CaseResult:
+    """Interpret one spec deterministically; returns the result with
+    the violation list, the event log, and the run digest (same spec
+    => same digest, the byte-identical-replay contract)."""
+    run = _Run(spec)
+    clock = SimClock()
+    with installed(clock):
+        run._build()
+        try:
+            for i, step in enumerate(spec.steps):
+                kind = step[0]
+                if kind == "w":
+                    run.do_write(i, int(step[1]), list(step[2]))
+                elif kind == "wf":
+                    run.do_write(i, int(step[1]), list(step[4]),
+                                 fault=(step[2], step[3]))
+                elif kind == "r":
+                    run.do_read(i, int(step[1]), list(step[2]))
+                elif kind == "rf":
+                    run.do_read(i, int(step[1]), list(step[2]),
+                                fault=("read-sync", "raise"))
+                elif kind == "corrupt":
+                    run.do_corrupt(i, int(step[1]))
+                elif kind == "probe":
+                    run.do_probe(i)
+                elif kind == "sync":
+                    if not run.primary_dead:
+                        run.wr.sync()
+                    run.ev(i, "sync")
+                elif kind == "wal-sync":
+                    run.do_wal_sync(i)
+                elif kind == "snapshot":
+                    run.do_snapshot(i)
+                elif kind == "crash":
+                    run.do_crash(i, int(step[1]), int(step[2]))
+                elif kind == "ship":
+                    run.do_ship(i)
+                elif kind == "zombie-ship":
+                    run.do_ship(i, zombie=True)
+                elif kind == "apply":
+                    run.do_apply(i)
+                elif kind == "fread":
+                    run.do_fread(i, list(step[1]), int(step[2]))
+                elif kind == "watch":
+                    run.do_watch(i, int(step[1]), clock)
+                elif kind == "kill":
+                    run.do_kill(i)
+                elif kind == "promote":
+                    run.do_promote(i)
+                else:
+                    raise ValueError(f"unknown step kind {kind!r}")
+            run.finalize()
+        finally:
+            run._teardown()
+    return CaseResult(
+        spec=spec,
+        violations=run.violations,
+        events=run.events,
+        digest=_digest(spec, run.events),
+    )

@@ -1,5 +1,21 @@
-"""Utilities: tracing/observability helpers."""
+"""Utilities: tracing/observability helpers + the injectable clock."""
 
+from node_replication_tpu.utils.clock import (
+    Clock,
+    RealClock,
+    SimClock,
+    get_clock,
+    set_clock,
+)
 from node_replication_tpu.utils.trace import Tracer, get_tracer, span
 
-__all__ = ["Tracer", "get_tracer", "span"]
+__all__ = [
+    "Clock",
+    "RealClock",
+    "SimClock",
+    "Tracer",
+    "get_clock",
+    "get_tracer",
+    "set_clock",
+    "span",
+]

@@ -1,0 +1,210 @@
+"""Injectable time source for every timed wait in the runtime planes.
+
+Every subsystem that waits on time — the serve frontend's linger and
+deadline sweeps, the retry client's backoff, the health machine's
+timeline stamps, the shipper/follower poll loops, the promotion
+watcher's heartbeat silence, the WAL's fsync spans — routes through
+ONE process-global `Clock` (`get_clock()`), so the simulation plane
+(`sim/`) can substitute virtual time and turn every timing-dependent
+robustness gate into a fast, reproducible unit test (the FoundationDB
+simulation-testing idiom). The nrlint rule `raw-clock-in-subsystem`
+machine-checks the routing: a direct `time.monotonic()` /
+`time.sleep()` / `Condition.wait()` inside serve/, fault/, repl/, or
+durable/ is a diagnostic — this module (and obs/, whose wall/mono
+stamps are correlation fields for external logs) is where the raw
+clock is allowed to live.
+
+Contract:
+
+- `now()` — monotonic seconds (ordering + durations; never steps).
+- `sleep(s)` — block the calling thread for `s` seconds.
+- `wait(cond, timeout)` — wait on an ALREADY-HELD
+  `threading.Condition` with an optional deadline; returns False iff
+  the timeout elapsed without a notification (the `Condition.wait`
+  contract). Routing condition waits through the clock is what lets
+  `SimClock` wake timed waiters when *virtual* time passes their
+  deadline.
+
+The default is `RealClock` — a zero-behavior-change veneer over
+`time.monotonic`/`time.sleep`/`Condition.wait`. `SimClock` is the
+virtual twin: time advances only via `advance()` (or instantly inside
+`sleep()` when `auto_advance=True`, the single-driver simulation
+mode), so a seeded schedule fully determines which timeouts fire and
+in what order.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class Clock:
+    """Injectable time source (see module docstring for the contract)."""
+
+    def now(self) -> float:
+        """Monotonic seconds."""
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        """Block the calling thread for `seconds`."""
+        raise NotImplementedError
+
+    def wait(self, cond: threading.Condition,
+             timeout: float | None = None) -> bool:
+        """Wait on a HELD condition; False iff the timeout elapsed."""
+        raise NotImplementedError
+
+
+class RealClock(Clock):
+    """The default: thin veneer over the OS monotonic clock."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
+
+    def wait(self, cond: threading.Condition,
+             timeout: float | None = None) -> bool:
+        return cond.wait(timeout)
+
+
+class SimClock(Clock):
+    """Virtual monotonic clock for deterministic simulation.
+
+    Time moves only when someone moves it: `advance(dt)` /
+    `advance_to(t)` from a driver thread, or — with `auto_advance=True`
+    (the default, the single-threaded harness mode) — instantly inside
+    `sleep()`, so a backoff or an injected stall costs zero wall time
+    while still being visible in virtual timelines.
+
+    Timed condition waits (`wait(cond, t)`) register a virtual
+    deadline and then block on the condition with NO real timeout: the
+    waiter wakes on a real `notify` or when `advance()` crosses its
+    deadline (the clock notifies the registered condition). A timed
+    wait therefore never spins and never races real time — under
+    simulation, "the linger expired" is an explicit schedule event.
+
+    Components driven by real OS threads under a SimClock must either
+    be configured without timed waits (e.g. `batch_linger_s=0`) or be
+    paired with a driver that advances the clock; `waiters()` exposes
+    the registered deadlines so a driver can advance exactly to the
+    next one.
+    """
+
+    def __init__(self, start: float = 0.0, auto_advance: bool = True):
+        self._cond = threading.Condition()
+        self._now = float(start)
+        self.auto_advance = bool(auto_advance)
+        # timed condition waiters: list of [deadline, cond] entries
+        # (list, not dict: the same cond may carry several deadlines)
+        self._waiters: list[list] = []
+
+    # ------------------------------------------------------------ Clock API
+
+    def now(self) -> float:
+        with self._cond:
+            return self._now
+
+    def sleep(self, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        with self._cond:
+            deadline = self._now + seconds
+            if self.auto_advance:
+                self._advance_locked(deadline)
+                return
+            while self._now < deadline:
+                self._cond.wait()
+
+    def wait(self, cond: threading.Condition,
+             timeout: float | None = None) -> bool:
+        if timeout is None:
+            cond.wait()
+            return True
+        with self._cond:
+            if timeout <= 0:
+                return False
+            entry = [self._now + timeout, cond]
+            self._waiters.append(entry)
+        try:
+            # block with no real timeout: a real notify or the clock
+            # crossing `deadline` (advance notifies `cond`) wakes us
+            cond.wait()
+        finally:
+            with self._cond:
+                if entry in self._waiters:
+                    self._waiters.remove(entry)
+                expired = self._now >= entry[0]
+        return not expired
+
+    # ----------------------------------------------------------- driver API
+
+    def advance(self, dt: float) -> float:
+        """Move virtual time forward by `dt`; wakes every sleeper and
+        timed waiter whose deadline the step crosses. Returns the new
+        time."""
+        with self._cond:
+            return self._advance_locked(self._now + float(dt))
+
+    def advance_to(self, t: float) -> float:
+        """Move virtual time to absolute `t` (no-op when in the past)."""
+        with self._cond:
+            return self._advance_locked(float(t))
+
+    def _advance_locked(self, t: float) -> float:
+        if t > self._now:
+            self._now = t
+        expired = [c for (d, c) in self._waiters if d <= self._now]
+        self._cond.notify_all()  # wake blocking sleepers
+        now = self._now
+        # notify outside our lock: a waiter woken by cond.notify will
+        # immediately try to take OUR lock to unregister (lock order
+        # cond -> clock there; taking cond under the clock lock here
+        # would be the reverse order — a deadlock)
+        if expired:
+            self._cond.release()
+            try:
+                for c in {id(c): c for c in expired}.values():
+                    with c:
+                        c.notify_all()
+            finally:
+                self._cond.acquire()
+        return now
+
+    def waiters(self) -> list[float]:
+        """Registered timed-wait deadlines, sorted (driver
+        introspection: `advance_to(waiters()[0])` fires exactly the
+        next timeout)."""
+        with self._cond:
+            return sorted(d for d, _ in self._waiters)
+
+
+_clock: Clock = RealClock()
+
+
+def get_clock() -> Clock:
+    """The process-global clock (default: `RealClock`)."""
+    return _clock
+
+
+def set_clock(clock: Clock) -> Clock:
+    """Install `clock` globally; returns the previous one."""
+    global _clock
+    prev = _clock
+    _clock = clock
+    return prev
+
+
+@contextlib.contextmanager
+def installed(clock: Clock):
+    """Context manager: install `clock`, restore the previous one on
+    exit (the test/simulation entry point)."""
+    prev = set_clock(clock)
+    try:
+        yield clock
+    finally:
+        set_clock(prev)

@@ -790,6 +790,65 @@ class TestNonDurablePublish:
         assert not firing(diags, "non-durable-publish")
 
 
+class TestRawClockInSubsystem:
+    def _lint_in(self, tmp_path, subdir, source):
+        import textwrap
+        d = tmp_path / subdir
+        d.mkdir(parents=True, exist_ok=True)
+        p = d / "snippet.py"
+        p.write_text(textwrap.dedent(source))
+        diags, errors = run_lint([str(p)])
+        assert not errors, errors
+        return diags
+
+    def test_monotonic_and_sleep_in_serve_fire(self, tmp_path):
+        diags = self._lint_in(tmp_path, "serve", """
+            import time
+
+            def linger(cond, t):
+                t_end = time.monotonic() + t
+                time.sleep(t)
+        """)
+        assert len(firing(diags, "raw-clock-in-subsystem")) == 2
+
+    def test_condition_wait_in_repl_fires(self, tmp_path):
+        diags = self._lint_in(tmp_path, "repl", """
+            class Shipper:
+                def loop(self):
+                    with self._cond:
+                        self._cond.wait(0.002)
+        """)
+        assert len(firing(diags, "raw-clock-in-subsystem")) == 1
+
+    def test_clock_routed_and_exempt_calls_clean(self, tmp_path):
+        diags = self._lint_in(tmp_path, "fault", """
+            import time
+
+            from node_replication_tpu.utils.clock import get_clock
+
+            def timed(cond, t):
+                clock = get_clock()
+                t_end = clock.now() + t
+                clock.wait(cond, t)         # routed: receiver is the clock
+                clock.sleep(0.01)
+                t0 = time.perf_counter()    # duration probe: exempt
+                evt_like.join(t)            # thread barrier: exempt
+                return time.perf_counter() - t0
+        """)
+        assert not firing(diags, "raw-clock-in-subsystem")
+
+    def test_outside_scoped_subsystems_clean(self, tmp_path):
+        # obs/ and utils/ (the clock module itself) are outside the
+        # rule's path scope — the raw clock legitimately lives there
+        diags = self._lint_in(tmp_path, "obs", """
+            import time
+
+            def stamp():
+                return time.monotonic()
+        """)
+        assert not firing(diags, "raw-clock-in-subsystem")
+
+
 class TestRepoIsClean:
     def test_package_lints_clean(self):
         # the CI gate, as a test: every violation in the package is

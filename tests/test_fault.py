@@ -176,6 +176,21 @@ class TestHealthStateMachine:
             (0, QUARANTINED, REPAIRING), (0, REPAIRING, HEALTHY),
         ]
 
+    def test_timeline_stamps_use_injected_clock(self):
+        # ISSUE 8 satellite regression: under SimClock the lifecycle
+        # timeline (and obs/report.py's fault section built from it)
+        # carries VIRTUAL stamps — a simulated quarantine at t=100.5
+        # is recorded at t=100.5, not at some wall-clock instant
+        from node_replication_tpu.utils.clock import SimClock, installed
+
+        with installed(SimClock(start=100.0)) as clock:
+            h = HealthTracker(1)
+            h.report_worker_exception(0)
+            clock.advance(0.5)
+            h.quarantine(0)
+        stamps = [ts for ts, *_ in h.timeline]
+        assert stamps == [100.0, 100.5]
+
     def test_illegal_transitions_raise(self):
         h = HealthTracker(1)
         with pytest.raises(IllegalTransition):

@@ -428,6 +428,77 @@ class TestRetry:
         for attempt in range(10):
             assert 0.0 <= policy.backoff_s(attempt, rng) <= 0.04
 
+    # -------------------------------------------- total deadline budget
+
+    def test_total_deadline_budget_bounds_attempts(self):
+        # ISSUE 8 satellite regression: the budget is enforced ACROSS
+        # attempts — with always-Overloaded service and backoffs far
+        # larger than the budget, the call gives up long before the
+        # attempt cap, and the whole call (backoffs included) never
+        # outlives the budget. SimClock makes the elapsed time exact.
+        from node_replication_tpu.utils.clock import SimClock, installed
+
+        fe = self.FlakyFrontend(fail_times=99)
+        policy = RetryPolicy(max_attempts=50, base_backoff_s=0.5,
+                             max_backoff_s=2.0, total_deadline_s=3.0)
+        with installed(SimClock()) as clock:
+            with pytest.raises(Overloaded):
+                call_with_retry(fe, (HM_PUT, 0, 0), policy=policy)
+            # backoff sleeps are capped by the remaining budget, so
+            # virtual elapsed time never exceeds it
+            assert clock.now() <= 3.0 + 1e-9
+        assert fe.calls < 50
+
+    def test_no_backoff_outlives_the_budget(self):
+        # a drawn backoff larger than the remaining budget re-raises
+        # instead of sleeping (so the slept delays observed by on_shed
+        # always fit inside the budget, and total virtual elapsed time
+        # never exceeds it)
+        from node_replication_tpu.utils.clock import SimClock, installed
+
+        fe = self.FlakyFrontend(fail_times=99)
+        delays = []
+        policy = RetryPolicy(max_attempts=50, base_backoff_s=1.0,
+                             max_backoff_s=10.0, total_deadline_s=2.0)
+        with installed(SimClock()) as clock:
+            with pytest.raises(Overloaded):
+                call_with_retry(
+                    fe, (HM_PUT, 0, 0), policy=policy,
+                    on_shed=lambda a, d: delays.append(d),
+                )
+            now = clock.now()
+        assert delays, "on_shed observed no attempts"
+        assert all(d <= 2.0 for d in delays)
+        assert now <= 2.0 + 1e-9
+
+    def test_budget_exhausted_before_sleep_reraises(self):
+        # a retry whose backoff would eat the whole remaining budget
+        # re-raises instead of sleeping into a guaranteed timeout
+        from node_replication_tpu.utils.clock import SimClock, installed
+
+        fe = self.FlakyFrontend(fail_times=99)
+        policy = RetryPolicy(max_attempts=5, base_backoff_s=1e9,
+                             max_backoff_s=1e9, total_deadline_s=0.5)
+        with installed(SimClock()) as clock:
+            with pytest.raises(Overloaded):
+                call_with_retry(fe, (HM_PUT, 0, 0), policy=policy)
+            assert clock.now() == 0.0  # gave up without sleeping
+        assert fe.calls >= 1
+
+    def test_no_budget_keeps_legacy_behavior(self):
+        fe = self.FlakyFrontend(fail_times=2)
+        policy = RetryPolicy(max_attempts=5, base_backoff_s=0.0001,
+                             max_backoff_s=0.001)
+        assert policy.total_deadline_s is None
+        assert call_with_retry(fe, (HM_PUT, 0, 0),
+                               policy=policy) == 42
+
+    def test_budget_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(total_deadline_s=0.0)
+        with pytest.raises(ValueError):
+            RetryPolicy(total_deadline_s=-1.0)
+
 
 class TestReadPath:
     def test_read_your_writes_and_no_queue_traffic(self):

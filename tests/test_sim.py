@@ -1,0 +1,286 @@
+"""Deterministic chaos plane (ISSUE 8): injectable clock, seeded
+cooperative scheduler, oracle-differential property harness, canary
+catches, byte-identical replay, delta-debugging shrinker."""
+
+import threading
+import time
+
+import pytest
+
+from node_replication_tpu.sim import canary
+from node_replication_tpu.sim.oracle import make_oracle
+from node_replication_tpu.sim.properties import (
+    FLAVORS,
+    CaseSpec,
+    generate_case,
+    run_case,
+)
+from node_replication_tpu.sim.scheduler import SimScheduler
+from node_replication_tpu.sim.shrink import shrink_case
+from node_replication_tpu.utils.clock import (
+    RealClock,
+    SimClock,
+    get_clock,
+    installed,
+    set_clock,
+)
+
+
+class TestClock:
+    def test_default_is_real_clock(self):
+        assert isinstance(get_clock(), RealClock)
+
+    def test_real_clock_contract(self):
+        c = RealClock()
+        t0 = c.now()
+        assert c.now() >= t0
+        cond = threading.Condition()
+        with cond:
+            t1 = time.monotonic()
+            assert c.wait(cond, 0.01) is False  # timeout, no notify
+            assert time.monotonic() - t1 < 1.0
+
+    def test_installed_restores(self):
+        prev = get_clock()
+        sim = SimClock()
+        with installed(sim):
+            assert get_clock() is sim
+        assert get_clock() is prev
+
+    def test_sim_sleep_auto_advances_instantly(self):
+        sim = SimClock()
+        t0 = time.monotonic()
+        sim.sleep(3600.0)
+        assert sim.now() == 3600.0
+        assert time.monotonic() - t0 < 1.0
+
+    def test_sim_sleep_blocks_until_advanced(self):
+        sim = SimClock(auto_advance=False)
+        woke = threading.Event()
+
+        def sleeper():
+            sim.sleep(5.0)
+            woke.set()
+
+        t = threading.Thread(target=sleeper, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not woke.is_set()
+        sim.advance(5.0)
+        assert woke.wait(5.0)
+        t.join(5.0)
+
+    def test_sim_timed_cond_wait_expires_on_advance(self):
+        sim = SimClock(auto_advance=False)
+        cond = threading.Condition()
+        out = {}
+
+        def waiter():
+            with cond:
+                out["r"] = sim.wait(cond, 5.0)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        for _ in range(200):
+            if sim.waiters():
+                break
+            time.sleep(0.005)
+        assert sim.waiters() == [5.0]
+        sim.advance(10.0)
+        t.join(5.0)
+        assert not t.is_alive()
+        assert out["r"] is False  # woke because virtual time expired
+
+    def test_sim_timed_cond_wait_honors_real_notify(self):
+        sim = SimClock(auto_advance=False)
+        cond = threading.Condition()
+        out = {}
+
+        def waiter():
+            with cond:
+                out["r"] = sim.wait(cond, 5.0)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        for _ in range(200):
+            if sim.waiters():
+                break
+            time.sleep(0.005)
+        with cond:
+            cond.notify_all()
+        t.join(5.0)
+        assert not t.is_alive()
+        assert out["r"] is True  # not expired: a real notification
+
+    def test_set_clock_returns_previous(self):
+        sim = SimClock()
+        prev = set_clock(sim)
+        try:
+            assert get_clock() is sim
+        finally:
+            assert set_clock(prev) is sim
+
+
+class TestScheduler:
+    def test_same_seed_same_schedule(self):
+        def build(seed):
+            s = SimScheduler(seed)
+            log = []
+            for name in ("a", "b", "c"):
+                s.add(name, lambda n=name: log.append(n) or True,
+                      weight={"a": 3.0, "b": 1.0, "c": 1.0}[name])
+            s.run(50)
+            return log
+
+        assert build(7) == build(7)
+        assert build(7) != build(8)
+
+    def test_disable_removes_from_schedule(self):
+        s = SimScheduler(1)
+        s.add("a", lambda: True)
+        s.add("b", lambda: True)
+        s.disable("a")
+        for _ in range(10):
+            name, _ = s.step()
+            assert name == "b"
+
+    def test_idle_limit_stops(self):
+        s = SimScheduler(1)
+        s.add("idle", lambda: False)
+        assert s.run(100, idle_limit=3) == 3
+
+
+class TestOracle:
+    def test_hashmap_semantics(self):
+        o = make_oracle("hashmap", 8)
+        assert o.apply((1, 3, 42)) == 0        # put
+        assert o.read((1, 3)) == 42            # get
+        assert o.apply((2, 3, 0)) == 1         # remove present
+        assert o.apply((2, 3, 0)) == 0         # remove absent
+        assert o.read((1, 3)) == -1
+        assert o.apply((1, 11, 9)) == 0        # k % 8 == 3
+        assert o.read((1, 3)) == 9
+
+    def test_stack_overflow_and_pop_empty(self):
+        o = make_oracle("stack", 2)
+        assert o.apply((1, 10, 0)) == 1
+        assert o.apply((1, 11, 0)) == 2
+        assert o.apply((1, 12, 0)) == -1       # full
+        assert o.apply((2, 0, 0)) == 11
+        assert o.apply((2, 0, 0)) == 10
+        assert o.apply((2, 0, 0)) == -1        # empty
+        assert o.read((2, 0)) == 0             # len
+
+    def test_queue_fifo_and_wrap(self):
+        o = make_oracle("queue", 2)
+        assert o.apply((1, 5, 0)) == 1
+        assert o.apply((1, 6, 0)) == 2
+        assert o.apply((1, 7, 0)) == -1        # full
+        assert o.apply((2, 0, 0)) == 5
+        assert o.apply((1, 7, 0)) == 2         # ring wraps
+        assert o.read((1, 0)) == 6             # front
+        assert o.read((2, 0)) == 2             # len
+
+    def test_seqreg_fetch_and_set(self):
+        o = make_oracle("seqreg", 4)
+        assert o.apply((1, 2, 7)) == 0
+        assert o.apply((1, 2, 9)) == 7
+        assert o.read((1, 2)) == 9
+
+    def test_copy_is_independent(self):
+        o = make_oracle("hashmap", 4)
+        o.apply((1, 1, 5))
+        c = o.copy()
+        c.apply((1, 1, 6))
+        assert o.read((1, 1)) == 5 and c.read((1, 1)) == 6
+
+
+def _find_spec(predicate, max_seed=80, **kw):
+    for seed in range(max_seed):
+        spec = generate_case(seed, **kw)
+        if predicate(spec):
+            return spec
+    raise AssertionError("no matching spec in seed range")
+
+
+class TestProperties:
+    @pytest.mark.parametrize("flavor", FLAVORS)
+    def test_every_flavor_holds_on_clean_code(self, flavor):
+        for seed in range(2):
+            spec = generate_case(seed, flavors=(flavor,))
+            res = run_case(spec)
+            assert res.ok, [v.as_dict() for v in res.violations]
+
+    def test_cnr_multilog_runs_the_same_fault_plans(self):
+        # the CNR/multilog path under chaos (ISSUE 8 satellite): a
+        # MultiLogReplicated case whose schedule injects write faults
+        # must hold every property, for both the wrapper and the
+        # serve flavor
+        for flavor in ("wrapper", "serve"):
+            spec = _find_spec(
+                lambda s: s.wrapper == "cnr"
+                and any(st[0] == "wf" for st in s.steps),
+                wrappers=("cnr",), flavors=(flavor,),
+            )
+            assert spec.wrapper == "cnr"
+            res = run_case(spec)
+            assert res.ok, [v.as_dict() for v in res.violations]
+
+    def test_corruption_is_detected_and_repaired(self):
+        spec = _find_spec(
+            lambda s: any(st[0] == "corrupt" for st in s.steps),
+            flavors=("wrapper",), wrappers=("nr",),
+        )
+        assert spec.n_replicas == 3  # quorum for the digest vote
+        res = run_case(spec)
+        # divergence-detect would fire had the vote missed it; every
+        # other property would fire had the repair been wrong
+        assert res.ok, [v.as_dict() for v in res.violations]
+
+    def test_replay_is_byte_identical(self):
+        spec1 = generate_case(0)
+        spec2 = generate_case(0)
+        assert spec1 == spec2
+        r1, r2 = run_case(spec1), run_case(spec2)
+        assert r1.digest == r2.digest
+        assert r1.events == r2.events
+
+    def test_spec_roundtrips_through_json(self):
+        spec = generate_case(5)
+        assert CaseSpec.from_dict(spec.as_dict()) == spec
+
+
+class TestCanaries:
+    def test_reclaim_ignores_pins_is_caught_and_shrinks(self):
+        # the reclaim-vs-ship race PR 6 closed, re-opened: a repl
+        # schedule with a lagging shipper across a snapshot+sync must
+        # observe a feed gap; the failing seed replays byte-
+        # identically and the shrinker reduces the schedule
+        with canary.armed("reclaim-ignores-pins"):
+            spec = generate_case(1, flavors=("repl",))
+            res = run_case(spec)
+            assert any(v.prop == "replication-gap"
+                       for v in res.violations), (
+                "canary survived", [v.as_dict()
+                                    for v in res.violations])
+            replay = run_case(generate_case(1, flavors=("repl",)))
+            assert replay.digest == res.digest
+            rep = shrink_case(spec, max_runs=80)
+            assert rep.shrunk_steps < rep.original_steps
+            assert any(v.prop == "replication-gap"
+                       for v in rep.result.violations)
+
+    def test_ack_before_fsync_is_caught(self):
+        with canary.armed("ack-before-fsync"):
+            spec = generate_case(3, flavors=("crash",))
+            res = run_case(spec)
+            assert any(v.prop == "durable-ack-survival"
+                       for v in res.violations)
+
+    def test_clean_run_after_canary_disarms(self):
+        spec = generate_case(3, flavors=("crash",))
+        assert run_case(spec).ok
+
+    def test_unknown_canary_raises(self):
+        with pytest.raises(ValueError):
+            canary.armed("no-such-bug")
