@@ -237,6 +237,428 @@ def serve_main(args) -> int:
     return 0
 
 
+def overload_main(args) -> int:
+    """`--overload`: the graceful-degradation gate (ISSUE 9).
+
+    Three phases over the seqreg oracle model:
+
+    1. **Capacity probe** — a short closed-loop run measures the
+       frontend's service capacity C (completed ops/sec) and its p95
+       latency, from which the SLO deadline D is derived.
+    2. **Static baseline** — open-loop arrivals at `--overload-factor`
+       × C (default 2×: sustained overload by construction), Poisson
+       epochs with heavy-tailed (Pareto) burst sizes and a
+       CRITICAL/NORMAL/BULK priority mix, against the PR 3 frontend
+       (static `queue_depth` bound, per-request deadline D, no
+       controller). The standing queue this builds converts most
+       completions into deadline misses — the binary degradation the
+       overload plane exists to fix.
+    3. **Adaptive run** — the SAME arrival schedule (same seed)
+       against `ServeConfig(overload=OverloadConfig(target=D/4))`
+       plus client-side circuit breakers; reads ride along and may
+       degrade to brownout (bounded-staleness) serving.
+
+    The reported metric is **goodput-under-SLO**: completed ops whose
+    client-perceived latency beat D, per second of wall. Hard gates
+    (exit 1): adaptive goodput must be STRICTLY higher than static;
+    the ack-chain verifier must find zero lost/duplicated acked ops in
+    either run (every completed fetch-and-set response must chain
+    `0 -> v1 -> ... -> final register read`, covering exactly the
+    acked set — a shed op that secretly executed breaks the chain);
+    zero CRITICAL sheds while lower-priority ops sat queued
+    (`priority_inversions == 0`); and no brownout read served beyond
+    its staleness bound. Rows append to `overload_benchmarks.csv`.
+    """
+    import random as _random
+    import threading
+
+    from node_replication_tpu import NodeReplicated
+    from node_replication_tpu.harness.mkbench import (
+        append_overload_csv,
+        overload_rows,
+    )
+    from node_replication_tpu.models import SR_GET, SR_SET, make_seqreg
+    from node_replication_tpu.obs.metrics import get_registry
+    from node_replication_tpu.serve import (
+        BULK,
+        CRITICAL,
+        NORMAL,
+        CircuitBreaker,
+        CircuitOpen,
+        DeadlineExceeded,
+        OverloadConfig,
+        Overloaded,
+        ServeConfig,
+        ServeFrontend,
+    )
+
+    get_registry().enable()
+    clients = args.overload_clients
+    rng = _random.Random(args.seed)
+    failures: list[str] = []
+
+    def build_fe(cfg):
+        nr = NodeReplicated(
+            make_seqreg(clients), n_replicas=1,
+            log_entries=1 << 14, gc_slack=1024, exec_window=1024,
+        )
+        return ServeFrontend(nr, cfg)
+
+    # ---- phase 1: capacity probe -----------------------------------
+    # Service capacity must be measured at FULL batching — a
+    # closed-loop probe with `clients` ops in flight measures
+    # concurrency-limited latency, not what the combiner can drain,
+    # and an arrival rate set from that number is not overload at
+    # all. So: pre-fill the queue open-loop and time the drain.
+    probe_cfg = ServeConfig(
+        queue_depth=max(4096, args.overload_probe_ops),
+        batch_max_ops=args.overload_batch, batch_linger_s=0.0005,
+    )
+    n_probe = args.overload_probe_ops
+    with build_fe(probe_cfg) as fe:
+        # warm pass, SAME shape as the timed one: the first batch of
+        # each padded size jit-compiles, and a compile inside the
+        # timed fill+drain would undermeasure capacity — the arrival
+        # rate derived from it would then not be overload at all
+        # (measured: ~2x undercount on cold caches)
+        for _ in range(2):
+            warm = [fe.submit((SR_SET, i % clients, 0), rid=0)
+                    for i in range(n_probe)]
+            fe.drain(timeout=60.0)
+            for f in warm:
+                f.result(5.0)
+        t0 = time.perf_counter()
+        futs = [fe.submit((SR_SET, i % clients, 0), rid=0)
+                for i in range(n_probe)]
+        fe.drain(timeout=60.0)
+        probe_dur = time.perf_counter() - t0
+        bad = sum(1 for f in futs if f.exception(5.0) is not None)
+        if bad:
+            failures.append(f"capacity probe: {bad} ops failed")
+    capacity = n_probe / probe_dur
+    # the SLO: a well-controlled queue (a couple of batches deep)
+    # completes within a handful of batch service times
+    batch_s = args.overload_batch / capacity
+    deadline = min(1.0, max(0.02, 8.0 * batch_s))
+    rate = args.overload_factor * capacity
+    # size the static queue so a FULL queue's standing delay is ~4x
+    # the deadline: at sustained 2x overload the baseline then lives
+    # the bufferbloat failure (admitted -> queued past the deadline ->
+    # swept), which is precisely the regime adaptive admission fixes —
+    # with a queue shorter than capacity x deadline the static bound
+    # would accidentally approximate a well-tuned limit and the
+    # comparison would measure nothing
+    qdepth = max(args.overload_queue_depth,
+                 int(capacity * deadline * 4.0))
+
+    # ---- arrival schedule: Poisson epochs, Pareto burst sizes ------
+    # one shared schedule (same seed) for both runs: (t, client, kind,
+    # priority, burst_id). ~1 in 6 arrivals is a read.
+    n_events = min(args.overload_ops,
+                   max(200, int(rate * args.overload_seconds)))
+    mean_burst = 3.0
+    schedule = []
+    t = 0.0
+    while len(schedule) < n_events:
+        t += rng.expovariate(rate / mean_burst)
+        burst = min(16, int(rng.paretovariate(1.5)))
+        for _ in range(burst):
+            kind = "r" if rng.random() < 1 / 6 else "w"
+            prio = rng.choices((CRITICAL, NORMAL, BULK),
+                               weights=(15, 55, 30))[0]
+            schedule.append((t, rng.randrange(clients), kind, prio))
+            if len(schedule) >= n_events:
+                break
+    # writes and reads run on SEPARATE per-client threads: a synced
+    # read under load blocks its thread for a full read-sync, and a
+    # blocking read inline in the write loop would silently convert
+    # the open loop into a submission-limited half-closed one — the
+    # "2x capacity" arrival rate would be fiction exactly when the
+    # system is busiest (measured: static never built a queue at all)
+    by_client = [[] for _ in range(clients)]
+    reads_by_client = [[] for _ in range(clients)]
+    for ev in schedule:
+        (reads_by_client if ev[2] == "r" else by_client)[
+            ev[1]].append(ev)
+
+    # ---- open-loop runner (used by both modes) ---------------------
+    def run_mode(mode, cfg, use_breaker):
+        fe = build_fe(cfg)
+        # warm THIS mode's fresh wrapper off-clock: the batch-size
+        # tiers and the read path re-trace/compile per instance, and a
+        # first-round compile inside the schedule window would expire
+        # the entire flood against a ~10ms-scale deadline before the
+        # worker can serve one batch. Warm writes write value 0, so
+        # the per-register ack chain still starts at 0.
+        warm = [fe.submit((SR_SET, i % clients, 0), rid=0)
+                for i in range(256)]
+        fe.drain(timeout=60.0)
+        for f in warm:
+            f.result(5.0)
+        for c in range(clients):
+            fe.read((SR_GET, c), rid=0, min_pos=0)
+        before = fe.stats()
+        acks = [[] for _ in range(clients)]  # (value, fut)
+        shed_vals = [[] for _ in range(clients)]
+        copen = [0]
+        copen_lock = threading.Lock()
+        breakers = [CircuitBreaker(failure_threshold=16,
+                                   cooldown_s=0.05)
+                    for _ in range(clients)] if use_breaker else None
+
+        def reader(c):
+            crng = _random.Random(args.seed * 1000 + c)
+            t0 = time.monotonic()
+            for ev_t, _c, _kind, _prio in reads_by_client[c]:
+                now = time.monotonic()
+                due = t0 + ev_t
+                if now < due:
+                    time.sleep(due - now)
+                try:
+                    fe.read((SR_GET, crng.randrange(clients)),
+                            rid=0)
+                except Exception:
+                    pass  # reads are load (+ brownout), not the oracle
+
+        def writer(c):
+            seq = 0
+            t0 = time.monotonic()
+            for ev_t, _c, _kind, prio in by_client[c]:
+                now = time.monotonic()
+                due = t0 + ev_t
+                if now < due:
+                    time.sleep(due - now)
+                if breakers is not None:
+                    try:
+                        breakers[c].before_call()
+                    except CircuitOpen:
+                        with copen_lock:
+                            copen[0] += 1
+                        continue
+                value = seq + 1
+                try:
+                    fut = fe.submit((SR_SET, c, value), rid=0,
+                                    deadline_s=deadline,
+                                    priority=prio)
+                except Overloaded:
+                    if breakers is not None:
+                        breakers[c].record_failure()
+                    shed_vals[c].append(value)
+                    continue
+                if breakers is not None:
+                    breakers[c].record_success()
+                seq = value
+                acks[c].append((value, fut))
+
+        ths = [threading.Thread(target=writer, args=(c,))
+               for c in range(clients)]
+        ths += [threading.Thread(target=reader, args=(c,))
+                for c in range(clients)]
+        t0 = time.perf_counter()
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        fe.drain(timeout=30.0)
+        duration = time.perf_counter() - t0
+        # goodput denominator: the SHARED experiment horizon — last
+        # scheduled arrival + the SLO deadline (no in-SLO completion
+        # can land later). Using measured wall (arrival window + drain
+        # tail) instead would let scheduler noise in the drain decide
+        # the static-vs-adaptive comparison; the horizon is identical
+        # for both modes by construction, so the gate reduces to the
+        # honest question: who completed more ops WITHIN the SLO.
+        horizon = schedule[-1][0] + deadline
+        # harvest futures + verify the ack chain per register
+        completed = good = evicted = missed = lost = dup = 0
+        lats: list[float] = []
+        for c in range(clients):
+            chain = {}  # resp -> written value, acked ops only
+            # min_pos=0 forces the SYNCED read path: the verification
+            # read must never be served from a brownout-stale replica
+            final = fe.read((SR_GET, c), rid=0, min_pos=0)
+            for value, fut in acks[c]:
+                exc = fut.exception(timeout=30.0)
+                if isinstance(exc, DeadlineExceeded):
+                    missed += 1
+                    continue
+                if isinstance(exc, Overloaded):
+                    evicted += 1
+                    shed_vals[c].append(value)
+                    continue
+                if exc is not None:
+                    failures.append(
+                        f"{mode}: client {c} value {value}: "
+                        f"unexpected {type(exc).__name__}: {exc}"
+                    )
+                    continue
+                completed += 1
+                lats.append(fut.latency_s)
+                if fut.latency_s <= deadline:
+                    good += 1
+                resp = int(fut.result())
+                if resp in chain:
+                    dup += 1
+                    failures.append(
+                        f"{mode}: client {c}: two acks chain from "
+                        f"{resp} (duplicated op)"
+                    )
+                chain[resp] = value
+            # walk 0 -> ... : must visit every acked op exactly once
+            # and end at the final register value
+            cur, visited = 0, 0
+            while cur in chain:
+                cur = chain.pop(cur)
+                visited += 1
+            if chain or cur != final:
+                lost += 1
+                failures.append(
+                    f"{mode}: client {c}: ack chain broke (visited "
+                    f"{visited}, {len(chain)} unreachable acks, "
+                    f"chain end {cur} vs register {final}) — a lost "
+                    f"ack or a shed op with a log effect"
+                )
+        after = fe.stats()
+        st = {k: after[k] - before[k]
+              for k in ("accepted", "shed", "evicted",
+                        "deadline_missed", "priority_inversions")}
+        st["shed_by_priority"] = {
+            k: (after["shed_by_priority"][k]
+                - before["shed_by_priority"][k])
+            for k in after["shed_by_priority"]
+        }
+        gov = fe.governor.stats() if fe.governor is not None else {}
+        fe.close()
+        lats.sort()
+
+        def pct(p):
+            return lats[int(p * (len(lats) - 1))] * 1e3 if lats else 0.0
+
+        arrivals = sum(len(b) for b in by_client)
+        return {
+            "mode": mode,
+            "clients": clients,
+            "capacity_ops": capacity,
+            "rate": rate,
+            "deadline_s": deadline,
+            "duration_s": duration,
+            "arrivals": arrivals,
+            "accepted": st["accepted"],
+            "completed": completed,
+            "good": good,
+            "goodput": good / horizon if horizon else 0.0,
+            "shed": st["shed"],
+            "shed_by_priority": st["shed_by_priority"],
+            "evicted": st["evicted"],
+            "circuit_open": copen[0],
+            "deadline_miss": st["deadline_missed"],
+            "brownout_reads": gov.get("brownout_reads", 0),
+            "max_brownout_lag": gov.get("max_brownout_lag", 0),
+            "priority_inversions": st["priority_inversions"],
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+            "lost": lost,
+            "duplicated": dup,
+        }
+
+    # ---- phase 2: static baseline ----------------------------------
+    static_cfg = ServeConfig(
+        queue_depth=qdepth,
+        batch_max_ops=args.overload_batch, batch_linger_s=0.0005,
+    )
+    static = run_mode("static", static_cfg, use_breaker=False)
+
+    # ---- phase 3: adaptive controller ------------------------------
+    adaptive_cfg = ServeConfig(
+        queue_depth=qdepth,
+        batch_max_ops=args.overload_batch, batch_linger_s=0.0005,
+        overload=OverloadConfig(
+            # the setpoint leaves the batch service time inside the
+            # SLO: queue delay ~deadline/2 + a couple of batch times
+            # of service still beats the deadline
+            target_delay_s=deadline / 2.0,
+            min_limit=max(4, args.overload_batch // 4),
+            brownout_max_lag=4096,
+        ),
+    )
+    adaptive = run_mode("adaptive", adaptive_cfg, use_breaker=True)
+
+    # ---- gates ------------------------------------------------------
+    if adaptive["goodput"] <= static["goodput"]:
+        failures.append(
+            f"adaptive goodput {adaptive['goodput']:.1f} ops/s did "
+            f"not beat static {static['goodput']:.1f} ops/s at "
+            f"{args.overload_factor}x capacity"
+        )
+    for run in (static, adaptive):
+        if run["priority_inversions"]:
+            failures.append(
+                f"{run['mode']}: {run['priority_inversions']} "
+                f"CRITICAL shed(s) while BULK/NORMAL ops sat queued"
+            )
+    if adaptive["max_brownout_lag"] > 4096:
+        failures.append(
+            f"brownout read served at lag "
+            f"{adaptive['max_brownout_lag']} > bound 4096"
+        )
+    if adaptive["shed_by_priority"]["critical"] > \
+            adaptive["shed_by_priority"]["bulk"] and \
+            adaptive["shed"] > 0:
+        failures.append(
+            "adaptive run shed more CRITICAL than BULK ops — "
+            "strict-priority shedding is not engaging"
+        )
+
+    rows = overload_rows("bench", static) + \
+        overload_rows("bench", adaptive)
+    append_overload_csv(args.serve_out, rows)
+    print(json.dumps({
+        "metric": "serve_overload_goodput_under_slo",
+        "value": round(adaptive["goodput"], 1),
+        "unit": "good_ops_per_sec",
+        "vs_static": round(
+            adaptive["goodput"] / static["goodput"], 3
+        ) if static["goodput"] else None,
+        "capacity_ops_per_sec": round(capacity, 1),
+        "arrival_rate": round(rate, 1),
+        "deadline_ms": round(deadline * 1e3, 2),
+        "static": {k: (round(v, 3) if isinstance(v, float) else v)
+                   for k, v in static.items()
+                   if k != "shed_by_priority"},
+        "adaptive": {k: (round(v, 3) if isinstance(v, float) else v)
+                     for k, v in adaptive.items()
+                     if k != "shed_by_priority"},
+        "shed_by_priority": {
+            "static": static["shed_by_priority"],
+            "adaptive": adaptive["shed_by_priority"],
+        },
+    }))
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    ratio = (
+        f"{adaptive['goodput'] / static['goodput']:.2f}x"
+        if static["goodput"] > 0 else "static collapsed to 0"
+    )
+    print(
+        f"# overload OK: goodput-under-SLO {adaptive['goodput']:.0f} "
+        f"vs static {static['goodput']:.0f} ops/s ({ratio}) "
+        f"at {args.overload_factor}x capacity "
+        f"({rate:.0f} arrivals/s, deadline {deadline * 1e3:.0f}ms); "
+        f"sheds c/n/b = "
+        f"{adaptive['shed_by_priority']['critical']}/"
+        f"{adaptive['shed_by_priority']['normal']}/"
+        f"{adaptive['shed_by_priority']['bulk']}, "
+        f"{adaptive['circuit_open']} circuit-open fast-fails, "
+        f"{adaptive['brownout_reads']} brownout read(s) "
+        f"(max lag {adaptive['max_brownout_lag']}); "
+        f"zero lost/duplicated acks in both runs",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def chaos_main(args) -> int:
     """`--chaos`: the serve bench under injected replica kills (ISSUE 4).
 
@@ -1233,6 +1655,50 @@ def main():
                             "overload probe")
     serve.add_argument("--serve-out", default=".",
                        help="directory for serve_benchmarks.csv")
+    overload = p.add_argument_group(
+        "overload", "graceful-degradation benchmark (--overload): "
+                    "open-loop Poisson + heavy-tailed burst arrivals "
+                    "at a multiple of measured capacity; exits 1 "
+                    "unless the adaptive controller beats the static "
+                    "queue_depth baseline on goodput-under-SLO with "
+                    "zero lost/dup acks, zero priority inversions, "
+                    "and in-bound brownout reads")
+    overload.add_argument("--overload", action="store_true",
+                          help="run the overload benchmark")
+    overload.add_argument("--overload-clients", type=int, default=4,
+                          help="client threads (and seqreg registers)")
+    overload.add_argument("--overload-probe-ops", type=int,
+                          default=1200,
+                          help="closed-loop ops for the capacity "
+                               "probe")
+    overload.add_argument("--overload-ops", type=int, default=8000,
+                          help="max open-loop arrivals per run (caps "
+                               "the schedule the rate would produce "
+                               "over --overload-seconds)")
+    overload.add_argument("--overload-seconds", type=float,
+                          default=4.0,
+                          help="target arrival-window length")
+    overload.add_argument("--overload-factor", type=float, default=2.0,
+                          help="arrival rate as a multiple of "
+                               "measured capacity")
+    overload.add_argument("--overload-queue-depth", type=int,
+                          default=256,
+                          help="static admission bound floor (grown "
+                               "to 4x capacity x deadline so the "
+                               "baseline actually exhibits "
+                               "bufferbloat)")
+    overload.add_argument("--overload-batch", type=int, default=8,
+                          help="batch_max_ops for both runs. Also "
+                               "sets the experiment's scale: service "
+                               "capacity (and so the 2x arrival "
+                               "rate) grows with it, and the fixed "
+                               "--overload-ops schedule must span "
+                               "many deadlines of sustained overload "
+                               "for the comparison to measure "
+                               "admission policy rather than "
+                               "transients — 8 puts the window near "
+                               "1s on a typical CPU runner")
+
     chaos = p.add_argument_group(
         "chaos", "fault-injection benchmark (--chaos): the closed-loop "
                  "sequence-verified serve run with a FaultPlan killing "
@@ -1321,9 +1787,9 @@ def main():
     if args.max_attempts < 1:
         p.error("--max-attempts must be >= 1")
     if sum(map(bool, (args.chaos, args.serve, args.crash,
-                      args.follower))) > 1:
-        p.error("--chaos, --serve, --crash and --follower are "
-                "mutually exclusive")
+                      args.follower, args.overload))) > 1:
+        p.error("--chaos, --serve, --crash, --follower and "
+                "--overload are mutually exclusive")
     if args.crash_child:
         if not args.crash_dir:
             p.error("--crash-child requires --crash-dir")
@@ -1341,6 +1807,8 @@ def main():
         sys.exit(chaos_main(args))
     if args.serve:
         sys.exit(serve_main(args))
+    if args.overload:
+        sys.exit(overload_main(args))
     if args.pallas:
         if args.path not in ("auto", "pallas"):
             p.error(f"--pallas conflicts with --path {args.path}")

@@ -1231,3 +1231,151 @@ def raw_clock_in_subsystem(mod: ModuleInfo,
                 "wake the waiter when virtual time passes its "
                 "deadline",
             )
+
+
+# --------------------------------------------------------------------------
+# unbounded-growth-in-subsystem
+# --------------------------------------------------------------------------
+
+#: package directories whose worker loops must bound every accumulator
+#: (the overload-plane memory contract: per-replica memory is
+#: O(queue_depth + batch), never load-proportional)
+_GROWTH_SUBSYSTEMS = ("serve", "repl")
+
+_APPEND_METHODS = ("append", "appendleft", "extend", "extendleft")
+_DRAIN_METHODS = ("pop", "popleft", "clear", "popitem")
+
+#: identifier fragments that mark a bound/watermark comparison
+_BOUND_TOKENS = ("depth", "maxlen", "watermark", "bound", "limit",
+                 "capacity", "max_")
+
+
+def _unbounded_init_attrs(cls_node: ast.ClassDef) -> set[str]:
+    """`self.X` attributes a class's `__init__` binds to an unbounded
+    container: `[]`, `list()`, or `deque()` without `maxlen`."""
+    attrs: set[str] = set()
+    for item in cls_node.body:
+        if not (isinstance(item, ast.FunctionDef)
+                and item.name == "__init__"):
+            continue
+        for node in ast.walk(item):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [a for a in map(_self_attr, node.targets)
+                     if a is not None]
+            if not names:
+                continue
+            v = node.value
+            unbounded = isinstance(v, ast.List) and not v.elts
+            if isinstance(v, ast.Call):
+                fn = v.func
+                callee = (
+                    fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None
+                )
+                if callee in ("deque", "list") and not any(
+                        kw.arg == "maxlen" for kw in v.keywords):
+                    unbounded = True
+            if unbounded:
+                attrs.update(names)
+    return attrs
+
+
+def _drained_attrs(cls_node: ast.ClassDef) -> set[str]:
+    """Attributes the class pops/clears SOMEWHERE — a drained
+    container is a queue, not an accumulator."""
+    out: set[str] = set()
+    for node in ast.walk(cls_node):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in _DRAIN_METHODS):
+            continue
+        attr = _self_attr(fn.value)
+        if attr is not None:
+            out.add(attr)
+    return out
+
+
+def _has_bound_check(fn: ast.AST) -> bool:
+    """A comparison over `len(...)` or over a bound/watermark-named
+    value anywhere in the function — the shape every honest
+    depth/watermark check takes."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id == "len"):
+                return True
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name is not None and any(
+                    tok in name.lower() for tok in _BOUND_TOKENS):
+                return True
+    return False
+
+
+@rule(
+    "unbounded-growth-in-subsystem", WARNING,
+    "worker-loop accumulator in serve//repl/ grows without a bound "
+    "or watermark check",
+)
+def unbounded_growth_in_subsystem(
+        mod: ModuleInfo, project: Project) -> Iterator[Diagnostic]:
+    """The overload-plane memory contract (`serve/overload.py`):
+    per-replica memory is O(queue_depth + batch), never
+    load-proportional — so every container a serve/ or repl/ WORKER
+    LOOP appends to must be bounded. Fires on `self.X.append/extend`
+    inside a thread-target function (or a helper it calls on the
+    worker thread, the `swallowed-worker-exception` closure) when `X`
+    was initialized as a bare `[]`/`list()`/`deque()` (no `maxlen`)
+    and neither (a) the enclosing function compares a `len(...)` or a
+    bound/watermark-named value (an admission/depth check), nor (b)
+    the class drains the container somewhere (`pop`/`popleft`/
+    `clear` — a queue, not an accumulator). An unbounded worker-side
+    accumulator is exactly how apply lag, ship backlog, or a retry
+    queue eats the heap under sustained overload — bound it, or wire
+    it to a watermark the admission controller can see."""
+    parts = re.split(r"[\\/]+", mod.path)
+    if not any(s in parts[:-1] for s in _GROWTH_SUBSYSTEMS):
+        return
+    unbounded: set[str] = set()
+    drained: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            unbounded |= _unbounded_init_attrs(node)
+            drained |= _drained_attrs(node)
+    growers = unbounded - drained
+    if not growers:
+        return
+    for name, fn in sorted(_thread_target_functions(mod,
+                                                    project).items()):
+        if _has_bound_check(fn):
+            continue
+        label = getattr(fn, "name", name)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            call_fn = node.func
+            if not (isinstance(call_fn, ast.Attribute)
+                    and call_fn.attr in _APPEND_METHODS):
+                continue
+            attr = _self_attr(call_fn.value)
+            if attr is None or attr not in growers:
+                continue
+            yield _diag(
+                mod, node, "unbounded-growth-in-subsystem",
+                f"{label}: self.{attr}.{call_fn.attr}() on the worker "
+                f"thread with no bound or watermark check and no "
+                f"drain path — under sustained overload this "
+                f"accumulator grows with load; cap it (deque(maxlen=)"
+                f"), drain it, or gate the append on a depth/"
+                f"watermark the admission controller enforces",
+            )

@@ -702,6 +702,13 @@ class NodeReplicated:
         while int(np.asarray(self.log.ltails)[rid]) < ctail:
             self._exec_round()
             rounds = self._watchdog(rounds, "read-sync")
+        return self._dispatch_read(rid, op)
+
+    def _dispatch_read(self, rid: int, op: tuple) -> int:
+        """Shared read-dispatch tail: pack args, run the read jit
+        against replica `rid`'s current state. `execute` (synced) and
+        `execute_stale` (brownout) must never diverge on this step.
+        Caller holds the combiner lock and has fence-checked."""
         args = np.zeros((self.spec.arg_width,), np.int32)
         args[: len(op) - 1] = op[1:]
         return int(
@@ -712,6 +719,55 @@ class NodeReplicated:
                 jnp.asarray(args),
             )
         )
+
+    @_locked
+    def read_lag(self, rid: int) -> int:
+        """Positions the completed tail leads replica `rid`'s applied
+        cursor by — the staleness a sync-free read on `rid` would
+        serve at. Locked for the same buffer-donation reason as
+        `ltail`. The serve brownout read path
+        (`serve/frontend.py:read`) checks this against its staleness
+        bound before taking `execute_stale`."""
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        ctail = int(self.log.ctail)
+        return max(0, ctail - int(np.asarray(self.log.ltails)[rid]))
+
+    @_locked
+    def execute_stale(self, op: tuple, token: ReplicaToken):
+        """Bounded-staleness read: dispatch against this replica's
+        CURRENT state with NO read-sync — the on-primary analog of the
+        follower read path (`repl/follower.py`), used by the serve
+        brownout mode. The caller owns the staleness contract: check
+        `read_lag(rid)` against the bound first (under load the
+        combiner rounds advance the replica continuously, so the lag
+        observed there still bounds what this read serves at — replay
+        only moves the replica FORWARD). Fenced replicas reject as on
+        every other entry point."""
+        rid = token.rid
+        if self._is_fenced(rid):
+            raise ReplicaFencedError(rid)
+        return self._dispatch_read(rid, op)
+
+    @_locked
+    def execute_stale_bounded(self, op: tuple, token: ReplicaToken,
+                              max_lag: int):
+        """`execute_stale` with the staleness bound enforced ATOMICALLY:
+        lag check and dispatch happen under one lock acquisition, so a
+        concurrent batch cannot advance the completed tail between a
+        caller's `read_lag` peek and the dispatch (that window would
+        let a "bounded" read silently serve beyond its bound — and
+        under-report the lag the bound gate records). Returns
+        `(value, lag)` when `lag <= max_lag`, else None (the caller
+        falls back to the synced path)."""
+        rid = token.rid
+        if self._is_fenced(rid):
+            raise ReplicaFencedError(rid)
+        ctail = int(self.log.ctail)
+        lag = max(0, ctail - int(np.asarray(self.log.ltails)[rid]))
+        if lag > int(max_lag):
+            return None
+        return self._dispatch_read(rid, op), lag
 
     @_locked
     def combine(self, rid: int) -> None:

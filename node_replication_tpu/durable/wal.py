@@ -357,6 +357,16 @@ class WriteAheadLog:
         deletes whole segments below the floor)."""
         return self._segments[0][0] if self._segments else self._tail
 
+    def fsync_lag(self) -> int:
+        """Positions written but not yet fsynced (`tail -
+        durable_tail`) — the journal's unfsynced backlog. Exported as
+        an overload-plane backpressure signal (`serve/overload.py`):
+        the serve frontend auto-registers this behind its
+        `wal_lag_low/high` watermarks so admission throttles before
+        the backlog (and the ship/ack pipeline behind it) can grow
+        unbounded. GIL-atomic int reads; no lock needed."""
+        return max(0, self._tail - self._durable)
+
     def _check_usable(self) -> None:
         if self._closed:
             raise WalError("WAL is closed")

@@ -60,6 +60,22 @@ SERVE_CSV = "serve_benchmarks.csv"
 CHAOS_CSV = "chaos_benchmarks.csv"
 RECOVERY_CSV = "recovery_benchmarks.csv"
 REPLICATION_CSV = "replication_benchmarks.csv"
+OVERLOAD_CSV = "overload_benchmarks.csv"
+# One row per overload run (`bench.py --overload`), static baseline
+# and adaptive controller side by side: open-loop Poisson arrivals at
+# `rate` (a multiple of the measured closed-loop `capacity_ops`) with
+# a heavy-tailed burst mix, `good` = completed within the deadline
+# SLO, `goodput_ops` = good/duration — the gated metric. Shed columns
+# split by priority class; `lost`/`duplicated` are the ack-chain
+# verifier's hard gates (both must be 0).
+_OVERLOAD_FIELDS = [
+    "name", "mode", "clients", "capacity_ops", "rate", "deadline_ms",
+    "duration", "arrivals", "accepted", "completed", "good",
+    "goodput_ops", "shed", "shed_critical", "shed_normal",
+    "shed_bulk", "evicted", "circuit_open", "deadline_miss",
+    "brownout_reads", "max_brownout_lag", "priority_inversions",
+    "p50_ms", "p99_ms", "lost", "duplicated",
+]
 # One row per follower-failover measurement (`bench.py --follower`):
 # the staleness-bounded read-scale-out phase (reads served against a
 # live follower, stale rejections counted) and the failover phase —
@@ -1002,6 +1018,44 @@ def replication_rows(name: str, report, *, clients: int, acked: int,
         "duplicated": duplicated,
         "post_restart_ops": post_restart_ops,
     }]
+
+
+def overload_rows(name: str, run: dict) -> list[dict]:
+    """The OVERLOAD_CSV row for one `bench.py --overload` run dict
+    (the bench builds one per mode: `static` and `adaptive`)."""
+    return [{
+        "name": f"{name}/{run['mode']}",
+        "mode": run["mode"],
+        "clients": run["clients"],
+        "capacity_ops": round(run["capacity_ops"], 1),
+        "rate": round(run["rate"], 1),
+        "deadline_ms": round(run["deadline_s"] * 1e3, 3),
+        "duration": round(run["duration_s"], 3),
+        "arrivals": run["arrivals"],
+        "accepted": run["accepted"],
+        "completed": run["completed"],
+        "good": run["good"],
+        "goodput_ops": round(run["goodput"], 1),
+        "shed": run["shed"],
+        "shed_critical": run["shed_by_priority"].get("critical", 0),
+        "shed_normal": run["shed_by_priority"].get("normal", 0),
+        "shed_bulk": run["shed_by_priority"].get("bulk", 0),
+        "evicted": run["evicted"],
+        "circuit_open": run["circuit_open"],
+        "deadline_miss": run["deadline_miss"],
+        "brownout_reads": run["brownout_reads"],
+        "max_brownout_lag": run["max_brownout_lag"],
+        "priority_inversions": run["priority_inversions"],
+        "p50_ms": round(run["p50_ms"], 3),
+        "p99_ms": round(run["p99_ms"], 3),
+        "lost": run["lost"],
+        "duplicated": run["duplicated"],
+    }]
+
+
+def append_overload_csv(out_dir: str, rows: list[dict]) -> None:
+    _append_csv(os.path.join(out_dir, OVERLOAD_CSV),
+                _OVERLOAD_FIELDS, rows)
 
 
 def append_replication_csv(out_dir: str, rows: list[dict]) -> None:

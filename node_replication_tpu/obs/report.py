@@ -166,13 +166,25 @@ def analyze(events: list[dict]) -> dict:
         s["last_ltail"] = e.get("ltail", s["last_ltail"])
         s["last_tail"] = e.get("tail", s["last_tail"])
 
-    # serve section: batch shape + admission control from serve-* events
+    # serve section: batch shape + admission control from serve-*
+    # events, incl. the overload plane (adaptive limit, priority
+    # sheds/evictions, brownout, per-cause client retries, breaker)
     serve = None
     batches = [e for e in events if e.get("event") == "serve-batch"]
     sheds = [e for e in events if e.get("event") == "serve-shed"]
     misses = [e for e in events
               if e.get("event") == "serve-deadline-miss"]
-    if batches or sheds or misses:
+    evicts = [e for e in events if e.get("event") == "serve-evict"]
+    retries = [e for e in events if e.get("event") == "serve-retry"]
+    limits = [e for e in events
+              if e.get("event") == "serve-admit-limit"]
+    brownouts = [e for e in events
+                 if e.get("event") == "serve-brownout"]
+    brownout_reads = [e for e in events
+                      if e.get("event") == "serve-brownout-read"]
+    circuits = [e for e in events if e.get("event") == "serve-circuit"]
+    if (batches or sheds or misses or evicts or retries or limits
+            or brownouts or circuits):
         sizes = sorted(int(e.get("n", 0)) for e in batches)
         size_hist: dict[int, int] = defaultdict(int)
         for n in sizes:
@@ -183,6 +195,19 @@ def analyze(events: list[dict]) -> dict:
             sec = int(_event_time(e, mono0, ts0))
             qdepth[sec] = max(qdepth.get(sec, 0),
                               int(e.get("queue_depth", 0)))
+        shed_by_prio: dict[str, int] = defaultdict(int)
+        for e in sheds:
+            shed_by_prio[str(e.get("prio", "?"))] += 1
+        retry_by_cause: dict[str, int] = defaultdict(int)
+        for e in retries:
+            retry_by_cause[str(e.get("cause", "?"))] += 1
+        # adaptive-admission timeline: min limit observed per second
+        # (the controller's most constrained moment of that second)
+        limit_tl: dict[int, int] = {}
+        for e in limits:
+            sec = int(_event_time(e, mono0, ts0))
+            lim = int(e.get("limit", 0))
+            limit_tl[sec] = min(limit_tl.get(sec, 1 << 30), lim)
         serve = {
             "batches": len(batches),
             "ops": sum(sizes),
@@ -191,7 +216,28 @@ def analyze(events: list[dict]) -> dict:
             "batch_size_hist": dict(sorted(size_hist.items())),
             "queue_depth_timeline": dict(sorted(qdepth.items())),
             "shed": len(sheds),
+            "shed_by_priority": dict(sorted(shed_by_prio.items())),
+            "evicted": len(evicts),
             "deadline_miss": sum(int(e.get("n", 1)) for e in misses),
+            "swept_at_admission": sum(
+                int(e.get("n", 1)) for e in misses
+                if e.get("swept")
+            ),
+            "retries_by_cause": dict(sorted(retry_by_cause.items())),
+            "admit_limit_timeline": dict(sorted(limit_tl.items())),
+            "brownout_transitions": [
+                {"t": round(_event_time(e, mono0, ts0), 3),
+                 "on": int(e.get("on", 0))}
+                for e in brownouts
+            ],
+            "brownout_reads": len(brownout_reads),
+            "max_brownout_lag": max(
+                (int(e.get("lag", 0)) for e in brownout_reads),
+                default=0,
+            ),
+            "circuit_transitions": sum(
+                1 for e in circuits if e.get("state") == "open"
+            ),
         }
 
     # fault section: lifecycle transitions + repair latencies from
@@ -395,8 +441,44 @@ def render(report: dict, out=None) -> None:
         w(f"  {serve['batches']} batch(es), {serve['ops']} ops, "
           f"p50 batch {serve['p50_batch']:.0f}, "
           f"max batch {serve['max_batch']}\n")
-        w(f"  shed (Overloaded): {serve['shed']}   "
-          f"deadline-missed: {serve['deadline_miss']}\n")
+        prio = serve.get("shed_by_priority") or {}
+        prio_s = (
+            " (" + " ".join(f"{k}={v}"
+                            for k, v in sorted(prio.items())) + ")"
+            if prio else ""
+        )
+        w(f"  shed (Overloaded): {serve['shed']}{prio_s}   "
+          f"evicted: {serve.get('evicted', 0)}   "
+          f"deadline-missed: {serve['deadline_miss']}"
+          + (f" ({serve['swept_at_admission']} swept at admission)"
+             if serve.get("swept_at_admission") else "") + "\n")
+        retries = serve.get("retries_by_cause") or {}
+        if retries:
+            w("  client retries by cause: "
+              + "   ".join(f"{k}={v}"
+                           for k, v in sorted(retries.items()))
+              + "\n")
+        if serve.get("circuit_transitions"):
+            w(f"  circuit-breaker opens: "
+              f"{serve['circuit_transitions']}\n")
+        if serve.get("brownout_reads") or serve.get(
+                "brownout_transitions"):
+            trans = " ".join(
+                f"{'on' if t['on'] else 'off'}@t+{t['t']}s"
+                for t in serve.get("brownout_transitions", [])
+            )
+            w(f"  brownout: {serve.get('brownout_reads', 0)} "
+              f"degraded read(s), max lag "
+              f"{serve.get('max_brownout_lag', 0)} pos"
+              + (f"   transitions: {trans}" if trans else "") + "\n")
+        ltl = serve.get("admit_limit_timeline") or {}
+        if ltl:
+            w("  adaptive admission limit (min per second):\n")
+            peak = max(ltl.values()) or 1
+            for sec in sorted(int(s) for s in ltl):
+                d = ltl.get(sec, ltl.get(str(sec), 0))
+                bar = "#" * max(1, round(30 * d / peak))
+                w(f"    t+{sec:>4}s limit {d:>6}  {bar}\n")
         hist = serve["batch_size_hist"]
         if hist:
             w("  batch-size histogram (<= bucket):\n")

@@ -264,6 +264,19 @@ class Follower:
         with self._cond:
             return self._applied
 
+    def lag(self) -> int:
+        """Positions the feed holds that this follower has not yet
+        applied — the apply-lag backpressure signal. Register it on
+        the primary frontend's admission controller
+        (`frontend.add_backpressure_source("apply", follower.lag,
+        low, high)`, in-process deployments) so a follower falling
+        behind slows primary admission instead of lagging without
+        bound; cross-process deployments feed the same number from
+        `repl.apply_lag_pos` through their own channel."""
+        with self._cond:
+            applied = self._applied
+        return max(0, self._feed.tail_pos() - applied)
+
     @property
     def error(self) -> BaseException | None:
         return self._error

@@ -269,6 +269,20 @@ class ReplicationShipper:
         """Positions fsynced on the primary but not yet shipped."""
         return max(0, self._wal.durable_tail - self._cursor)
 
+    def install_backpressure(self, frontend, low: int = 512,
+                             high: int = 4096) -> None:
+        """Feed this shipper's lag into the frontend's admission
+        controller (`serve/overload.py:LagSource` watermarks): between
+        `low` and `high` admission stops growing; at/above `high` it
+        shrinks multiplicatively every round. Combined with
+        `barrier` installed as the frontend's `ack_barrier`
+        (ship-before-ack), this closes the loop the overload plane
+        promises — semi-sync replication can never build an unbounded
+        ship backlog, because the primary slows admission instead.
+        Requires the frontend's overload plane
+        (`ServeConfig(overload=...)`); raises otherwise."""
+        frontend.add_backpressure_source("ship", self.lag, low, high)
+
     def stats(self) -> dict:
         with self._cond:
             return {

@@ -18,10 +18,12 @@ stays untouched underneath.
 """
 
 from node_replication_tpu.serve.client import (
+    CircuitBreaker,
     RetryPolicy,
     call_with_retry,
 )
 from node_replication_tpu.serve.errors import (
+    CircuitOpen,
     DeadlineExceeded,
     FrontendClosed,
     NotPrimary,
@@ -35,11 +37,27 @@ from node_replication_tpu.serve.frontend import (
     ServeFrontend,
 )
 from node_replication_tpu.serve.future import ServeFuture
+from node_replication_tpu.serve.overload import (
+    BULK,
+    CRITICAL,
+    NORMAL,
+    LagSource,
+    OverloadConfig,
+    OverloadGovernor,
+)
 
 __all__ = [
+    "BULK",
+    "CRITICAL",
+    "CircuitBreaker",
+    "CircuitOpen",
     "DeadlineExceeded",
     "FrontendClosed",
+    "LagSource",
+    "NORMAL",
     "NotPrimary",
+    "OverloadConfig",
+    "OverloadGovernor",
     "Overloaded",
     "ReplicaFailed",
     "RetryPolicy",

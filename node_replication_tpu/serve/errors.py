@@ -30,6 +30,12 @@ flat under `ServeError`:
 - `NotPrimary` — a write submitted to a read-only (follower-mode)
   frontend (`repl/follower.py`); writes belong on the primary until a
   promotion (`enable_writes`) re-homes write serving here.
+- `CircuitOpen` — the CLIENT-side circuit breaker
+  (`serve/client.py:CircuitBreaker`) refused the call before it
+  reached the frontend: enough consecutive transient failures opened
+  the circuit and the cool-down has not elapsed. The op was never
+  submitted (zero log effect by construction); retry after
+  `retry_after_s`, when the breaker's half-open probe window opens.
 """
 
 from __future__ import annotations
@@ -42,18 +48,30 @@ class ServeError(RuntimeError):
 class Overloaded(ServeError):
     """Admission queue full: the request was shed at the door.
 
-    Carries the replica id and the queue depth observed at rejection so
-    callers (and the bench's shed-rate accounting) can report where the
-    pressure is. The op was NEVER enqueued — retrying is always safe.
+    Carries the replica id and the admission limit observed at
+    rejection so callers (and the bench's shed-rate accounting) can
+    report where the pressure is. With the overload plane on
+    (`ServeConfig.overload`), `depth` is the ADAPTIVE limit of the
+    moment (<= the static queue depth), `priority` names the shed
+    op's class, and `evicted=True` marks an op that WAS admitted but
+    was evicted from the queue by a higher-priority arrival — in
+    every case the op never reached the log, so retrying is always
+    safe.
     """
 
-    def __init__(self, rid: int, depth: int):
+    def __init__(self, rid: int, depth: int,
+                 priority: int | None = None, evicted: bool = False):
+        how = "evicted by a higher-priority arrival" if evicted \
+            else "request shed"
+        prio = "" if priority is None else f" (priority {priority})"
         super().__init__(
-            f"replica {rid} admission queue full ({depth} pending); "
-            f"request shed"
+            f"replica {rid} admission queue full ({depth} "
+            f"admitted){prio}; {how}"
         )
         self.rid = rid
         self.depth = depth
+        self.priority = priority
+        self.evicted = evicted
 
 
 class DeadlineExceeded(ServeError):
@@ -150,3 +168,25 @@ class NotPrimary(ServeError):
             f"route writes to the primary or promote this follower"
         )
         self.rid = rid
+
+
+class CircuitOpen(ServeError):
+    """The client-side circuit breaker is open: the call was refused
+    BEFORE submission (`serve/client.py:CircuitBreaker`).
+
+    Enough consecutive transient failures (`Overloaded`, retryable
+    `ReplicaFailed`) tripped the breaker; until the cool-down elapses
+    every call fails fast here instead of adding load to a frontend
+    that is already shedding. The op was never submitted — zero log
+    effect by construction — so retrying after `retry_after_s` is
+    always safe (`call_with_retry` does so, with backoff, and the
+    breaker lets a single half-open probe through first).
+    """
+
+    def __init__(self, retry_after_s: float, failures: int):
+        super().__init__(
+            f"circuit open after {failures} consecutive transient "
+            f"failures; retry in {retry_after_s * 1e3:.0f}ms"
+        )
+        self.retry_after_s = retry_after_s
+        self.failures = failures

@@ -20,8 +20,16 @@ Production edges, each with a typed signal (`serve/errors.py`):
   assembly drops expired requests with `DeadlineExceeded` *before*
   appending, so a timed-out op is guaranteed to have had no effect.
 - **backpressure** — clients see `Overloaded` the moment service lags
-  admission; `serve/client.py` layers retry-with-backoff on top for
-  closed-loop callers.
+  admission; `serve/client.py` layers retry-with-backoff (and a
+  circuit breaker) on top for closed-loop callers.
+- **overload plane** (`ServeConfig.overload`, `serve/overload.py`) —
+  the static bound becomes an ADAPTIVE limit: an AIMD controller per
+  replica keyed to measured queue delay, strict-priority shedding
+  (`submit(priority=)`: BULK evicts first, CRITICAL last, with the
+  inversion counter proving it), brownout reads (degrade to the
+  bounded-staleness `execute_stale` path instead of shedding), and
+  downstream-lag watermarks (WAL fsync lag, `repl/` ship/apply lag)
+  that throttle admission before any backlog grows unbounded.
 - **graceful drain** — `close()` stops admission, flushes every queued
   op through the combiner, resolves all futures, and joins the
   workers; `close(drain=False)` rejects the backlog instead.
@@ -78,6 +86,15 @@ from node_replication_tpu.serve.errors import (
     StaleRead,
 )
 from node_replication_tpu.serve.future import ServeFuture
+from node_replication_tpu.serve.overload import (
+    CRITICAL,
+    NORMAL,
+    PRIORITIES,
+    PRIORITY_NAMES,
+    LagSource,
+    OverloadConfig,
+    OverloadGovernor,
+)
 from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer
 
@@ -105,6 +122,21 @@ class ServeConfig:
       `ReplicaFailed` to in-flight callers, queued requests re-homed,
       `on_replica_failed` lifecycle callback) instead of rejecting the
       batch and limping on. See the module docstring and `fault/`.
+    - `overload` — the adaptive overload plane (`serve/overload.py`):
+      an `OverloadConfig` turns on the per-replica AIMD admission
+      controller (limit adapts to measured queue delay each combiner
+      round), brownout reads (past the watermark, reads degrade to the
+      bounded-staleness `execute_stale` path instead of shedding), and
+      downstream-lag backpressure (the WAL's fsync lag auto-registers
+      when a WAL is attached; `repl/` ship/apply lag via
+      `install_backpressure`/`add_backpressure_source`). None
+      (default) keeps the static `queue_depth` bound only. Priority
+      classes on `submit()` and strict-priority shedding (BULK evicts
+      first, CRITICAL last) are active either way — without a
+      governor they order shedding at the static bound.
+    - `wal_lag_low` / `wal_lag_high` — watermarks (log positions) for
+      the auto-registered WAL fsync-lag backpressure source (only
+      read when `overload` is set and a WAL is attached).
     - `durability` — the durable-ack contract against the wrapper's
       attached write-ahead log (`durable/wal.py`). `"none"` (default):
       acks are in-memory only (the pre-durability semantics, WAL or
@@ -126,6 +158,9 @@ class ServeConfig:
     drain_timeout_s: float = 30.0
     failover: bool = False
     durability: str = "none"
+    overload: OverloadConfig | None = None
+    wal_lag_low: int = 1024
+    wal_lag_high: int = 8192
 
     def __post_init__(self):
         if self.queue_depth < 1:
@@ -139,12 +174,30 @@ class ServeConfig:
                 f"unknown durability {self.durability!r} "
                 f"(none | batch | always)"
             )
+        if not 0 <= self.wal_lag_low < self.wal_lag_high:
+            raise ValueError(
+                "wal lag watermarks need 0 <= low < high"
+            )
+        if (self.overload is not None
+                and self.overload.target_delay_s
+                <= self.batch_linger_s):
+            # the AIMD signal (oldest wait at batch assembly) includes
+            # the deliberate linger at light load; a target at or
+            # below it would read an idle frontend as congested and
+            # pin admission at the floor
+            raise ValueError(
+                f"overload.target_delay_s "
+                f"({self.overload.target_delay_s}) must exceed "
+                f"batch_linger_s ({self.batch_linger_s}): the "
+                f"queue-delay signal includes the linger"
+            )
 
 
 @dataclasses.dataclass
 class _Request:
     op: tuple
     future: ServeFuture
+    priority: int = NORMAL
 
 
 class _ReplicaDown(Exception):
@@ -166,22 +219,54 @@ class _ReplicaDown(Exception):
         self.maybe_executed = maybe_executed
 
 
+class _OfferResult:
+    """Outcome of one admission attempt (`_SubmissionQueue.offer`).
+
+    `expired` and `evicted` carry requests the queue REMOVED under its
+    lock; the frontend rejects their futures after releasing it (a
+    future's done-callbacks run user code — never under the queue
+    lock). `inversion` marks the invariant breach the priority plane
+    exists to prevent: a CRITICAL shed while a lower-priority op sat
+    queued (structurally impossible via eviction; measured anyway)."""
+
+    __slots__ = ("admitted", "expired", "evicted", "inversion")
+
+    def __init__(self, admitted, expired, evicted, inversion=False):
+        self.admitted = admitted
+        self.expired = expired
+        self.evicted = evicted
+        self.inversion = inversion
+
+
 class _SubmissionQueue:
-    """Bounded MPSC admission queue for one replica.
+    """Bounded, priority-aware MPSC admission queue for one replica.
 
     Many client threads `offer`; one worker `take_batch`es. All state
     lives under one condition (`_lock`): depth check + enqueue is a
     single critical section, so admission control cannot over-admit
     under contention. Counters (accepted / shed / completed / missed)
     live here too so `stats()` needs no frontend-level lock.
+
+    Priority discipline: one FIFO deque per class (CRITICAL / NORMAL /
+    BULK). Batches drain strictly by class; at a full queue an
+    arriving request EVICTS the newest queued request of a strictly
+    lower class rather than shedding itself, so BULK always sheds
+    first and a CRITICAL op sheds only into a queue of CRITICALs.
+    Deadline-expired requests are swept OUT at admission time (they
+    were dead weight holding admission slots — the pre-fix behavior
+    kept them until batch assembly, so a queue full of corpses shed
+    live traffic).
     """
 
     __slots__ = ("_lock", "_items", "_depth", "_closed", "_in_service",
-                 "accepted", "shed", "completed", "deadline_missed")
+                 "accepted", "shed", "completed", "deadline_missed",
+                 "evicted", "shed_by_prio", "priority_inversions")
 
     def __init__(self, depth: int):
         self._lock = threading.Condition()
-        self._items: deque[_Request] = deque()
+        self._items: tuple[deque[_Request], ...] = tuple(
+            deque() for _ in PRIORITIES
+        )
         self._depth = depth
         self._closed = False
         self._in_service = 0  # ops taken by the worker, not yet finished
@@ -189,31 +274,93 @@ class _SubmissionQueue:
         self.shed = 0
         self.completed = 0
         self.deadline_missed = 0
+        self.evicted = 0
+        self.shed_by_prio = [0 for _ in PRIORITIES]
+        self.priority_inversions = 0
 
-    def offer(self, req: _Request) -> bool:
-        """Admit or shed. False = full (caller raises Overloaded)."""
+    def _depth_unlocked(self) -> int:
+        return sum(len(d) for d in self._items)
+
+    def _sweep_expired_unlocked(self, now: float) -> list[_Request]:
+        """Remove deadline-expired queued requests (all classes) and
+        return them for rejection — the eager sweep that keeps corpses
+        from occupying admission slots until batch assembly.
+
+        Cost discipline: each class is walked ONLY while its head is
+        expired — per-class FIFO arrival makes the head the oldest
+        deadline whenever requests share a `deadline_s` (the common
+        case), so the gate is O(1) per offer and each swept request is
+        removed exactly once (amortized O(1) per admission). An
+        unconditional full walk here measurably strangled the queue
+        lock under flood arrivals — submitters sweeping O(depth) per
+        offer starved the worker's `take_batch` on the same condition.
+        A corpse hiding behind a younger head (mixed per-request
+        deadlines) still drops at batch assembly, the pre-fix
+        behavior."""
+        expired: list[_Request] = []
+        for d in self._items:
+            while d:
+                dl = d[0].future.deadline
+                if dl is None or now <= dl:
+                    break
+                expired.append(d.popleft())
+        if expired:
+            # nrlint: disable=lock-discipline — caller (offer) holds it
+            self.deadline_missed += len(expired)
+        return expired
+
+    def offer(self, req: _Request, limit: int,
+              now: float) -> _OfferResult:
+        """Admit, evict-to-admit, or shed, against the (possibly
+        adaptive) `limit`. Expired queued requests are swept first
+        whenever the queue is at its limit."""
         with self._lock:
             if self._closed:
                 raise FrontendClosed()
-            if len(self._items) >= self._depth:
-                self.shed += 1
-                return False
-            self._items.append(req)
-            self.accepted += 1
-            self._lock.notify()
-            return True
+            expired: list[_Request] = []
+            if self._depth_unlocked() >= limit:
+                expired = self._sweep_expired_unlocked(now)
+            if self._depth_unlocked() < limit:
+                self._items[req.priority].append(req)
+                self.accepted += 1
+                self._lock.notify()
+                return _OfferResult(True, expired, None)
+            # full at the adaptive limit: strict-priority shedding —
+            # evict the NEWEST queued request of the LOWEST class
+            # strictly below this one (BULK goes first)
+            for p in range(len(PRIORITIES) - 1, req.priority, -1):
+                if self._items[p]:
+                    evicted = self._items[p].pop()
+                    self._items[req.priority].append(req)
+                    self.accepted += 1
+                    self.evicted += 1
+                    self.shed += 1
+                    self.shed_by_prio[evicted.priority] += 1
+                    self._lock.notify()
+                    return _OfferResult(True, expired, evicted)
+            self.shed += 1
+            self.shed_by_prio[req.priority] += 1
+            inversion = req.priority == CRITICAL and any(
+                self._items[p]
+                for p in range(CRITICAL + 1, len(PRIORITIES))
+            )
+            if inversion:
+                self.priority_inversions += 1
+            return _OfferResult(False, expired, None, inversion)
 
     def readmit(self, req: _Request) -> bool:
         """Enqueue a request re-homed from a FAILED replica's queue
         WITHOUT counting a second admission — the original queue
         already counted it `accepted` (and its counters fold into the
         frontend aggregates), so `offer` here would double-count.
-        False when closed or full (not a shed: the caller rejects with
+        Bounded by the STATIC depth (re-homing is not subject to the
+        adaptive limit — the op was already admitted once). False when
+        closed or full (not a shed: the caller rejects with
         `ReplicaFailed`, not `Overloaded`)."""
         with self._lock:
-            if self._closed or len(self._items) >= self._depth:
+            if self._closed or self._depth_unlocked() >= self._depth:
                 return False
-            self._items.append(req)
+            self._items[req.priority].append(req)
             self._lock.notify()
             return True
 
@@ -223,24 +370,28 @@ class _SubmissionQueue:
         """Block for the next batch; None = closed and fully drained.
         Waits for the first op, then lingers up to `linger_s` for the
         batch to fill — unless a full batch is already queued or the
-        queue is closing (drain fast)."""
+        queue is closing (drain fast). Drains strictly by priority
+        class (CRITICAL first), FIFO within each class."""
         clock = get_clock()
         with self._lock:
-            while not self._items and not self._closed:
+            while not self._depth_unlocked() and not self._closed:
                 clock.wait(self._lock)
-            if not self._items:
+            if not self._depth_unlocked():
                 return None  # closed and empty: worker exits
-            if (linger_s > 0 and len(self._items) < max_ops
+            if (linger_s > 0 and self._depth_unlocked() < max_ops
                     and not self._closed):
                 t_end = clock.now() + linger_s
-                while len(self._items) < max_ops and not self._closed:
+                while (self._depth_unlocked() < max_ops
+                       and not self._closed):
                     rem = t_end - clock.now()
                     if rem <= 0:
                         break
                     clock.wait(self._lock, rem)
-            n = min(max_ops, len(self._items))
-            batch = [self._items.popleft() for _ in range(n)]
-            self._in_service = n
+            batch: list[_Request] = []
+            for d in self._items:
+                while d and len(batch) < max_ops:
+                    batch.append(d.popleft())
+            self._in_service = len(batch)
             return batch
 
     def batch_done(self, completed: int, missed: int) -> None:
@@ -253,7 +404,7 @@ class _SubmissionQueue:
 
     def depth(self) -> int:
         with self._lock:
-            return len(self._items)
+            return self._depth_unlocked()
 
     def wait_idle(self, timeout: float | None = None) -> bool:
         """Block until no op is queued or in service (drain barrier)."""
@@ -262,7 +413,7 @@ class _SubmissionQueue:
             None if timeout is None else clock.now() + timeout
         )
         with self._lock:
-            while self._items or self._in_service:
+            while self._depth_unlocked() or self._in_service:
                 rem = (
                     None if t_end is None else t_end - clock.now()
                 )
@@ -278,18 +429,29 @@ class _SubmissionQueue:
             self._closed = True
             leftovers: list[_Request] = []
             if not drain:
-                leftovers = list(self._items)
-                self._items.clear()
+                for d in self._items:
+                    leftovers.extend(d)
+                    d.clear()
             self._lock.notify_all()
             return leftovers
 
     def stats(self) -> dict:
         with self._lock:
             return {
-                "queued": len(self._items),
+                "queued": self._depth_unlocked(),
+                "queued_by_priority": {
+                    PRIORITY_NAMES[p]: len(self._items[p])
+                    for p in PRIORITIES
+                },
                 "in_service": self._in_service,
                 "accepted": self.accepted,
                 "shed": self.shed,
+                "shed_by_priority": {
+                    PRIORITY_NAMES[p]: self.shed_by_prio[p]
+                    for p in PRIORITIES
+                },
+                "evicted": self.evicted,
+                "priority_inversions": self.priority_inversions,
                 "completed": self.completed,
                 "deadline_missed": self.deadline_missed,
             }
@@ -346,6 +508,32 @@ class ServeFrontend:
         # fsync barrier per batch only in "batch" mode ("always" is
         # already durable inside the wrapper's append)
         self._durable_sync = self.cfg.durability == "batch"
+        #: adaptive overload plane (`serve/overload.py`); None = the
+        #: static queue_depth bound only (the pre-overload behavior)
+        self.governor: OverloadGovernor | None = None
+        if self.cfg.overload is not None:
+            self.governor = OverloadGovernor(
+                self.cfg.overload, self.cfg.queue_depth,
+                deadline_s=self.cfg.default_deadline_s,
+            )
+            if hasattr(nr, "wal"):
+                # end-to-end backpressure, leg 1: the journal's
+                # unfsynced backlog throttles admission before it can
+                # grow unbounded (repl/ ship+apply lag register via
+                # install_backpressure / add_backpressure_source).
+                # The WAL is resolved at POLL time, not construction:
+                # attach_wal after the frontend is built (the normal
+                # PR-5 flow under durability="none") must still wire
+                # this leg — a construction-time snapshot would leave
+                # it silently dead. No WAL attached = lag 0.
+                def _wal_fsync_lag():
+                    wal = getattr(self._nr, "wal", None)
+                    return 0 if wal is None else wal.fsync_lag()
+
+                self.governor.add_source(LagSource(
+                    "wal-fsync", _wal_fsync_lag,
+                    self.cfg.wal_lag_low, self.cfg.wal_lag_high,
+                ))
         # guards _queues/_workers/_read_tokens/_closed topology changes
         # (grow, close); the hot submit path reads the dicts lock-free
         # (GIL-atomic lookups; workers are keyed once at creation)
@@ -361,6 +549,7 @@ class ServeFrontend:
         # aggregate stats survive a restart's queue swap
         self._failed: dict[int, BaseException] = {}
         self._retired: dict[str, int] = {}
+        self._retired_prio: dict[str, int] = {}
         self._rehomed = 0
         #: lifecycle callback `fn(rid, exc)` — the `fault/` manager
         #: installs itself here to quarantine + repair + restart
@@ -461,6 +650,8 @@ class ServeFrontend:
         )
         token = self._nr.register(rid)
         gauge = get_registry().gauge(f"serve.queue_depth.r{rid}")
+        if self.governor is not None:
+            self.governor.register_replica(rid)
         return q, t, token, gauge
 
     def start(self) -> None:
@@ -593,8 +784,13 @@ class ServeFrontend:
                 raise ValueError(f"replica {rid} has not failed")
             old = self._queues[rid].stats()
             for k in ("accepted", "shed", "completed",
-                      "deadline_missed"):
+                      "deadline_missed", "evicted",
+                      "priority_inversions"):
                 self._retired[k] = self._retired.get(k, 0) + old[k]
+            for name, v in old["shed_by_priority"].items():
+                self._retired_prio[name] = (
+                    self._retired_prio.get(name, 0) + v
+                )
             q = _SubmissionQueue(self.cfg.queue_depth)
             t = threading.Thread(
                 target=self._worker_loop, args=(rid, q),
@@ -667,17 +863,31 @@ class ServeFrontend:
     # ------------------------------------------------------------ client API
 
     def submit(self, op: tuple, rid: int = 0,
-               deadline_s: float | None = None) -> ServeFuture:
+               deadline_s: float | None = None,
+               priority: int = NORMAL) -> ServeFuture:
         """Stage one write op on replica `rid`; returns its future.
-        Raises `Overloaded` when the admission queue is full,
-        `FrontendClosed` after `close()`, (failover mode)
-        `ReplicaFailed` while the replica is down, and (follower mode)
-        `NotPrimary` while writes are disabled — all BEFORE the op
-        can have any effect."""
+        Raises `Overloaded` when the admission queue is full at its
+        (possibly adaptive) limit, `FrontendClosed` after `close()`,
+        (failover mode) `ReplicaFailed` while the replica is down, and
+        (follower mode) `NotPrimary` while writes are disabled — all
+        BEFORE the op can have any effect.
+
+        `priority` (`serve.overload.CRITICAL/NORMAL/BULK`) orders
+        shedding, strictly: at a full queue a higher-priority arrival
+        evicts the newest queued lower-priority request (whose future
+        rejects with `Overloaded(evicted=True)`) instead of shedding
+        itself, so BULK traffic always sheds first. Deadline-expired
+        queued requests are swept out at admission time — a corpse
+        never costs a live request its slot."""
         if self._read_only:
             # follower mode (`repl/`): no write is ever admitted, so a
             # rejected caller can safely resubmit against the primary
             raise NotPrimary(rid)
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"unknown priority {priority!r} (CRITICAL=0 NORMAL=1 "
+                f"BULK=2)"
+            )
         # closed wins over failed: a closed frontend is PERMANENT and
         # must not hand retry loops a retryable ReplicaFailed
         if not self._closed and rid in self._failed:  # GIL-atomic reads
@@ -689,13 +899,16 @@ class ServeFrontend:
                              f"(have {self.rids})")
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
-        deadline = (
-            None if deadline_s is None
-            else get_clock().now() + deadline_s
+        now = get_clock().now()
+        deadline = None if deadline_s is None else now + deadline_s
+        gov = self.governor
+        limit = (
+            self.cfg.queue_depth if gov is None
+            else min(self.cfg.queue_depth, gov.limit(rid))
         )
         fut = ServeFuture(rid, deadline=deadline)
         try:
-            admitted = q.offer(_Request(op, fut))
+            res = q.offer(_Request(op, fut, priority), limit, now)
         except FrontendClosed:
             # a per-replica queue closed while the frontend is open can
             # only mean this replica failed (or is mid-restart): that
@@ -705,19 +918,68 @@ class ServeFrontend:
                     rid, self._failed.get(rid), maybe_executed=False
                 ) from None
             raise
-        if not admitted:
+        self._finish_offer(rid, res, limit, now)
+        if not res.admitted:
             self._m_shed.inc()
-            get_tracer().emit("serve-shed", rid=rid,
-                              depth=self.cfg.queue_depth)
-            raise Overloaded(rid, self.cfg.queue_depth)
+            if gov is not None:
+                gov.note_shed(priority)
+            get_tracer().emit("serve-shed", rid=rid, depth=limit,
+                              prio=PRIORITY_NAMES[priority])
+            raise Overloaded(rid, limit, priority=priority)
         self._m_submitted.inc()
         return fut
 
+    def _finish_offer(self, rid: int, res: _OfferResult, limit: int,
+                      now: float) -> None:
+        """Resolve the futures `offer` removed under its lock — the
+        eagerly swept expired requests and the priority eviction —
+        and do their accounting (outside the queue lock: rejection
+        runs user done-callbacks)."""
+        for req in res.expired:
+            late = now - (req.future.deadline or now)
+            req.future._reject(DeadlineExceeded(rid, late))
+        if res.expired:
+            self._m_miss.inc(len(res.expired))
+            get_tracer().emit("serve-deadline-miss", rid=rid,
+                              n=len(res.expired), swept=1)
+        ev = res.evicted
+        if ev is not None:
+            self._m_shed.inc()
+            if self.governor is not None:
+                self.governor.note_shed(ev.priority, evicted=True)
+            get_tracer().emit("serve-evict", rid=rid,
+                              prio=PRIORITY_NAMES[ev.priority])
+            ev.future._reject(Overloaded(
+                rid, limit, priority=ev.priority, evicted=True,
+            ))
+        if res.inversion:
+            # the queue already counted it (priority_inversions, the
+            # invariant the sim/bench gates assert stays zero); make
+            # it loud in the trace too
+            get_tracer().emit("serve-priority-inversion", rid=rid)
+
     def call(self, op: tuple, rid: int = 0,
              deadline_s: float | None = None,
-             timeout: float | None = None):
+             timeout: float | None = None,
+             priority: int = NORMAL):
         """Closed-loop convenience: `submit` + `result`."""
-        return self.submit(op, rid, deadline_s).result(timeout)
+        return self.submit(op, rid, deadline_s,
+                           priority=priority).result(timeout)
+
+    def add_backpressure_source(self, name: str, fn, low: int,
+                                high: int) -> None:
+        """Attach a downstream lag feed to the admission controller
+        (`serve/overload.py:LagSource` semantics: no influence below
+        `low`, growth pause between, multiplicative decrease at/above
+        `high`). Raises when the overload plane is off — silently
+        ignoring a backpressure wire would let the backlog it guards
+        grow unbounded."""
+        if self.governor is None:
+            raise ValueError(
+                "backpressure needs the overload plane: construct the "
+                "frontend with ServeConfig(overload=OverloadConfig())"
+            )
+        self.governor.add_source(LagSource(name, fn, low, high))
 
     @property
     def read_only(self) -> bool:
@@ -747,11 +1009,39 @@ class ServeFrontend:
         waiting up to `wait_s` seconds and then rejecting with a typed
         `StaleRead` — a client never silently observes state older
         than its bound. On a primary the bound is trivially satisfied
-        (the write path replays before responding)."""
+        (the write path replays before responding).
+
+        **Brownout** (`ServeConfig.overload`): while the governor is
+        in brownout, a read WITHOUT an explicit `min_pos` degrades to
+        the bounded-staleness path instead of paying read-sync — it
+        dispatches against the replica's current state
+        (`execute_stale`) when the replica's lag is within
+        `OverloadConfig.brownout_max_lag`, falling back to the synced
+        path when it is not. A brownout read can therefore never
+        exceed its staleness bound; the worst lag actually served is
+        recorded (`governor.stats()['max_brownout_lag']`). An
+        explicit `min_pos` (read-your-writes) always takes the synced
+        path — a client that asked for a bound gets that bound."""
         token = self._read_tokens.get(rid)
         if token is None:
             raise ValueError(f"replica {rid} is not served "
                              f"(have {self.rids})")
+        gov = self.governor
+        if (min_pos is None and gov is not None and gov.brownout()
+                and hasattr(self._nr, "execute_stale_bounded")):
+            # bound check + dispatch are ONE lock acquisition inside
+            # the wrapper — a separate read_lag peek would race a
+            # concurrent batch advancing the completed tail and serve
+            # (and under-record) beyond the bound
+            hit = self._nr.execute_stale_bounded(
+                op, token, gov.cfg.brownout_max_lag
+            )
+            if hit is not None:
+                value, lag = hit
+                gov.note_brownout_read(lag)
+                return value
+            # replica too far behind for the brownout bound: pay the
+            # synced path rather than serve beyond the bound
         if min_pos is not None:
             min_pos = int(min_pos)
             ltail = getattr(self._nr, "ltail", None)
@@ -781,19 +1071,29 @@ class ServeFrontend:
         with self._lock:  # grow() can resize the dict mid-iteration
             queues = sorted(self._queues.items())
             retired = dict(self._retired)
+            retired_prio = dict(self._retired_prio)
             rehomed = self._rehomed
             failed = sorted(self._failed)
         per = {rid: q.stats() for rid, q in queues}
         agg = {
             k: sum(s[k] for s in per.values())
             for k in ("queued", "in_service", "accepted", "shed",
-                      "completed", "deadline_missed")
+                      "completed", "deadline_missed", "evicted",
+                      "priority_inversions")
+        }
+        agg["shed_by_priority"] = {
+            name: sum(s["shed_by_priority"][name]
+                      for s in per.values())
+            + retired_prio.get(name, 0)
+            for name in PRIORITY_NAMES
         }
         for k, v in retired.items():
             agg[k] += v
         agg["rehomed"] = rehomed
         agg["failed"] = failed
         agg["replicas"] = per
+        if self.governor is not None:
+            agg["overload"] = self.governor.stats()
         return agg
 
     # ------------------------------------------------------------ worker
@@ -853,6 +1153,14 @@ class ServeFrontend:
             q.batch_done(0, 0)
             raise _ReplicaDown(e, batch, maybe_executed=False) from e
         now = get_clock().now()
+        if self.governor is not None and batch:
+            # the control signal: how long the batch's OLDEST request
+            # waited between admission and assembly (CoDel's sojourn
+            # time) — one AIMD update per combiner round
+            delay = max(
+                0.0, now - min(r.future.t_submit for r in batch)
+            )
+            self.governor.on_round(rid, delay, len(batch))
         live: list[_Request] = []
         missed = 0
         for req in batch:

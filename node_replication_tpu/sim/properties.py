@@ -51,6 +51,21 @@ Property catalog (each violation carries the property name):
   (the reclaim-vs-ship protection failing).
 - ``zombie-unfenced``    — a superseded primary's shipper published
   past the promotion fence.
+- ``shed-honesty``       — a shed response (`Overloaded`, an
+  eviction, or a client-side `CircuitOpen`) for an op the log
+  nonetheless holds — a shed MUST have zero log effect.
+- ``priority-inversion`` — a CRITICAL op was shed while a
+  lower-priority op sat queued (the overload plane's strict-priority
+  eviction exists to make this impossible; the queue counts it at
+  the shed decision point, under its lock).
+
+The serve flavor's ``burst`` steps drive the overload plane
+deterministically: a paused frontend (workers not started) admits a
+mixed-priority burst against a tiny adaptive limit — every
+shed/evict/circuit decision lands on the driver thread — then starts,
+drains, and the interpreter reads the ACTUAL ring slice back to fold
+the oracle in true log order and check the two properties above plus
+``resp-diff``.
 """
 
 from __future__ import annotations
@@ -161,6 +176,16 @@ def _gen_write(rng: random.Random, model: str, size: int,
     raise ValueError(model)
 
 
+def _gen_unique_write(rng: random.Random, model: str, size: int,
+                      uniq: int) -> list:
+    """One INSERT-shaped mutating op with a unique payload — burst
+    steps need every logged write distinguishable so the ring slice
+    maps back to its request (POP/REMOVE ops all encode alike)."""
+    if model in ("hashmap", "seqreg"):
+        return [1, rng.randrange(size), uniq]  # PUT / SR_SET
+    return [1, uniq, 0]  # ST_PUSH / Q_ENQ
+
+
 def _gen_read(rng: random.Random, model: str, size: int) -> list:
     if model == "hashmap":
         return [1, rng.randrange(size), 0]  # HM_GET
@@ -243,6 +268,22 @@ def generate_case(
                 steps.append(["probe"])
             else:
                 w()
+        if flavor == "serve" and wrapper == "nr":
+            # overload bursts (a FRESH rng stream: the base schedule
+            # above — and every other flavor's — stays byte-identical
+            # to the pre-overload generator, so failing-seed artifacts
+            # and canary expectations survive)
+            brng = random.Random(int(seed) ^ 0xB0057)
+            buniq = 100_000  # disjoint from the w() uniq range
+            for _ in range(brng.randrange(1, 3)):
+                burst = []
+                for _ in range(brng.randrange(8, 15)):
+                    prio = brng.choices((0, 1, 2),
+                                        weights=(1, 2, 2))[0]
+                    burst.append([prio, _gen_unique_write(
+                        brng, model, MODEL_SIZES[model], buniq)])
+                    buniq += 1
+                steps.append(["burst", burst])
         steps.append(["sync"])
         return CaseSpec(seed, model, wrapper, flavor, R, nlogs, steps)
 
@@ -364,6 +405,7 @@ class _Run:
         self.follower = None
         self.pm = None
         self.oracle_f = None
+        self.breaker = None  # per-case client circuit breaker (burst)
         self.fpos = 0
         self.primary_dead = False
         self.promoted = False
@@ -620,6 +662,142 @@ class _Run:
                      f"read {op} on r{rid} -> {int(val)}, "
                      f"oracle {int(expect)}")
         self.ev(i, "r", val=int(val))
+
+    # ------------------------------------------------------ burst steps
+
+    def do_burst(self, i: int, specs: list) -> None:
+        """One overload burst (serve flavor, NR): a PAUSED temporary
+        frontend (tiny adaptive limit, priorities) admits the whole
+        mixed-priority burst on the driver thread — every shed /
+        eviction / circuit decision is deterministic — then starts,
+        drains, and closes. The ACTUAL ring slice is read back to
+        fold the oracle in true log order; checks `shed-honesty`
+        (every rejected op absent from the log), `priority-inversion`
+        (queue-measured), and `resp-diff` on the completed futures."""
+        if self.spec.flavor != "serve" or self.spec.wrapper != "nr":
+            self.ev(i, "burst-skip")
+            return
+        from node_replication_tpu.core.log import ring_slice
+        from node_replication_tpu.serve.client import CircuitBreaker
+        from node_replication_tpu.serve.errors import (
+            CircuitOpen,
+            Overloaded,
+        )
+        from node_replication_tpu.serve.frontend import (
+            ServeConfig,
+            ServeFrontend,
+        )
+        from node_replication_tpu.serve.overload import OverloadConfig
+
+        if self.breaker is None:
+            # SimClock time does not advance on its own, so an opened
+            # circuit stays open for the rest of the case — which is
+            # exactly the zero-log-effect path the property wants hit
+            self.breaker = CircuitBreaker(failure_threshold=3,
+                                          cooldown_s=30.0)
+        tail0 = int(np.asarray(self.wr.log.tail))
+        cfg = ServeConfig(
+            queue_depth=6, batch_max_ops=4, batch_linger_s=0.0,
+            overload=OverloadConfig(target_delay_s=0.005,
+                                    min_limit=2),
+        )
+        fe = ServeFrontend(self.wr, cfg, rids=[0], auto_start=False)
+        aw = int(self.wr.spec.arg_width)
+
+        def key_of(op) -> tuple:
+            """Normalize an op to the ring's (opcode, *args[:aw])
+            width — the same padding `_check_ring` applies."""
+            key = [int(op[0])] + [int(x) for x in op[1:1 + aw]]
+            key += [0] * (1 + aw - len(key))
+            return tuple(key)
+
+        futs: list = []  # (index, op, future|None, outcome)
+        for k, (prio, op) in enumerate(specs):
+            op = list(op)
+            try:
+                self.breaker.before_call()
+            except CircuitOpen:
+                futs.append((k, op, None, "copen"))
+                continue
+            try:
+                fut = fe.submit(tuple(op), rid=0, priority=int(prio))
+            except Overloaded:
+                self.breaker.record_failure()
+                futs.append((k, op, None, "shed"))
+                continue
+            self.breaker.record_success()
+            futs.append((k, op, fut, "admitted"))
+        fe.start()
+        fe.drain(timeout=30)
+        stats = fe.stats()
+        fe.close(drain=True)
+        outcomes: list = []
+        completed: dict[tuple, tuple] = {}  # op -> (index, resp)
+        rejected: list[tuple] = []  # (index, op, kind)
+        for k, op, fut, outcome in futs:
+            if fut is None:
+                outcomes.append([k, outcome])
+                rejected.append((k, op, outcome))
+                continue
+            exc = fut.exception(timeout=30)
+            if exc is not None:
+                kind = ("evicted"
+                        if isinstance(exc, Overloaded) else
+                        f"err-{type(exc).__name__}")
+                outcomes.append([k, kind])
+                rejected.append((k, op, kind))
+                continue
+            outcomes.append([k, "completed"])
+            completed[key_of(op)] = (k, int(fut.result()))
+        if stats["priority_inversions"]:
+            self.vio("priority-inversion", i,
+                     f"{stats['priority_inversions']} CRITICAL "
+                     f"shed(s) while lower-priority ops sat queued")
+        tail1 = int(np.asarray(self.wr.log.tail))
+        if tail1 - tail0 != len(completed):
+            self.vio("shed-honesty", i,
+                     f"log advanced {tail1 - tail0} but "
+                     f"{len(completed)} op(s) completed — a rejected "
+                     f"op left a log effect (or an acked one none)")
+        ring_ops: list[list] = []
+        if tail1 > tail0:
+            opcodes, args = ring_slice(self.wr.spec, self.wr.log,
+                                       tail0, tail1)
+            aw = args.shape[1]
+            for k in range(tail1 - tail0):
+                ring_ops.append(
+                    [int(opcodes[k])] + [int(x) for x in args[k]]
+                )
+        seen = set()
+        for rop in ring_ops:
+            key = tuple(rop)  # already (opcode, *args[:aw]); unique
+            expect = self.oracle.apply(key)
+            self.applied.append(list(key))
+            hit = completed.pop(key, None)
+            if hit is None or key in seen:
+                self.vio("shed-honesty", i,
+                         f"log holds {list(key)} which no completed "
+                         f"burst op acked (shed/evicted/circuit-open "
+                         f"op with a log effect, or a duplicate)")
+                continue
+            seen.add(key)
+            if int(hit[1]) != int(expect):
+                self.vio("resp-diff", i,
+                         f"burst op {list(key)} -> {hit[1]}, oracle "
+                         f"{int(expect)}")
+        for key, (k, resp) in completed.items():
+            self.vio("shed-honesty", i,
+                     f"burst op {list(key)} acked {resp} but the log "
+                     f"never recorded it")
+        for k, op, kind in rejected:
+            if key_of(op) in seen:
+                self.vio("shed-honesty", i,
+                         f"{kind} op {op} found in the log")
+        self.ev(i, "burst", outcomes=outcomes,
+                shed=int(stats["shed"]),
+                evicted=int(stats["evicted"]),
+                applied=len(ring_ops),
+                breaker=self.breaker.state)
 
     # -------------------------------------------------------- fault steps
 
@@ -975,6 +1153,8 @@ def run_case(spec: CaseSpec) -> CaseResult:
                 elif kind == "rf":
                     run.do_read(i, int(step[1]), list(step[2]),
                                 fault=("read-sync", "raise"))
+                elif kind == "burst":
+                    run.do_burst(i, list(step[1]))
                 elif kind == "corrupt":
                     run.do_corrupt(i, int(step[1]))
                 elif kind == "probe":

@@ -849,6 +849,132 @@ class TestRawClockInSubsystem:
         assert not firing(diags, "raw-clock-in-subsystem")
 
 
+class TestUnboundedGrowthInSubsystem:
+    def _lint_in(self, tmp_path, subdir, source):
+        import textwrap
+        d = tmp_path / subdir
+        d.mkdir(parents=True, exist_ok=True)
+        p = d / "snippet.py"
+        p.write_text(textwrap.dedent(source))
+        diags, errors = run_lint([str(p)])
+        assert not errors, errors
+        return diags
+
+    def test_worker_append_without_bound_fires(self, tmp_path):
+        # the accumulator pattern the rule exists for: a worker loop
+        # appending to an __init__-unbounded deque with no depth check
+        # and no drain path anywhere in the class
+        diags = self._lint_in(tmp_path, "repl", """
+            import threading
+            from collections import deque
+
+            class Shipper:
+                def __init__(self):
+                    self._backlog = deque()
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    while True:
+                        self._backlog.append(self.next_record())
+        """)
+        assert len(firing(diags, "unbounded-growth-in-subsystem")) == 1
+
+    def test_helper_on_worker_thread_fires(self, tmp_path):
+        # transitive closure: the append lives in a helper the worker
+        # loop calls (same closure swallowed-worker-exception uses)
+        diags = self._lint_in(tmp_path, "serve", """
+            import threading
+
+            class Frontend:
+                def __init__(self):
+                    self._retries = []
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    while True:
+                        self._stash(self.take())
+
+                def _stash(self, req):
+                    self._retries.append(req)
+        """)
+        assert len(firing(diags, "unbounded-growth-in-subsystem")) == 1
+
+    def test_bound_check_and_drain_clean(self, tmp_path):
+        # three sanctioned shapes: a len() bound compare in the
+        # appending function, a deque(maxlen=), and a container the
+        # class drains (a queue, not an accumulator)
+        diags = self._lint_in(tmp_path, "serve", """
+            import threading
+            from collections import deque
+
+            class Frontend:
+                def __init__(self):
+                    self._queue = deque()
+                    self._recent = deque(maxlen=64)
+                    self._ready = []
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    while True:
+                        if len(self._queue) >= self.depth:
+                            continue
+                        self._queue.append(self.take())
+                        self._recent.append(1)
+                        self._drain()
+
+                def _drain(self):
+                    while self._ready:
+                        self._ready.pop()
+        """)
+        assert not firing(diags, "unbounded-growth-in-subsystem")
+
+    def test_watermark_named_bound_clean(self, tmp_path):
+        # a watermark comparison counts as the bound check even
+        # without len() (the lag-vs-high-watermark idiom)
+        diags = self._lint_in(tmp_path, "repl", """
+            import threading
+
+            class Applier:
+                def __init__(self):
+                    self._pending = []
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    while True:
+                        if self.lag() > self.high_watermark:
+                            continue
+                        self._pending.append(self.take())
+        """)
+        assert not firing(diags, "unbounded-growth-in-subsystem")
+
+    def test_outside_subsystem_and_non_worker_clean(self, tmp_path):
+        # same accumulator outside serve//repl/ is out of scope; and
+        # inside scope, an append on a NON-worker path (no Thread
+        # target reaches it) is the client's business, not the rule's
+        diags = self._lint_in(tmp_path, "harness", """
+            import threading
+
+            class Collector:
+                def __init__(self):
+                    self._rows = []
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    while True:
+                        self._rows.append(self.take())
+        """)
+        assert not firing(diags, "unbounded-growth-in-subsystem")
+        diags = self._lint_in(tmp_path, "serve", """
+            class Future:
+                def __init__(self):
+                    self._callbacks = []
+
+                def add_done_callback(self, fn):
+                    self._callbacks.append(fn)
+        """)
+        assert not firing(diags, "unbounded-growth-in-subsystem")
+
+
 class TestRepoIsClean:
     def test_package_lints_clean(self):
         # the CI gate, as a test: every violation in the package is

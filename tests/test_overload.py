@@ -1,0 +1,668 @@
+"""Overload plane (ISSUE 9): adaptive admission, priority shedding,
+brownout reads, backpressure watermarks, circuit breaker, per-cause
+retry accounting."""
+
+import threading
+import time
+
+import pytest
+
+from node_replication_tpu import NodeReplicated
+from node_replication_tpu.models import SR_GET, SR_SET, make_seqreg
+from node_replication_tpu.obs.metrics import get_registry
+from node_replication_tpu.serve import (
+    BULK,
+    CRITICAL,
+    NORMAL,
+    CircuitBreaker,
+    CircuitOpen,
+    DeadlineExceeded,
+    LagSource,
+    OverloadConfig,
+    OverloadGovernor,
+    Overloaded,
+    ReplicaFailed,
+    RetryPolicy,
+    ServeConfig,
+    ServeFrontend,
+    call_with_retry,
+)
+
+
+def make_nr(regs=8, replicas=1):
+    return NodeReplicated(
+        make_seqreg(regs), n_replicas=replicas,
+        log_entries=512, gc_slack=64,
+    )
+
+
+# ==========================================================================
+# OverloadGovernor: the AIMD loop, watermarks, brownout hysteresis
+# ==========================================================================
+
+
+class TestGovernor:
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            OverloadConfig(target_delay_s=0)
+        with pytest.raises(ValueError):
+            OverloadConfig(decrease=1.0)
+        with pytest.raises(ValueError):
+            OverloadConfig(brownout_enter=1.0, brownout_exit=1.0)
+        with pytest.raises(ValueError):
+            OverloadConfig(min_limit=0)
+
+    def test_congested_round_multiplicative_decrease(self):
+        cfg = OverloadConfig(target_delay_s=0.01, min_limit=4,
+                             decrease=0.5)
+        g = OverloadGovernor(cfg, queue_depth=64)
+        g.register_replica(0)
+        assert g.limit(0) == 64  # cold start at full depth
+        g.on_round(0, queue_delay_s=0.05, n_ops=8)
+        assert g.limit(0) == 32
+        g.on_round(0, queue_delay_s=0.05, n_ops=8)
+        assert g.limit(0) == 16
+        for _ in range(10):
+            g.on_round(0, queue_delay_s=0.05, n_ops=8)
+        assert g.limit(0) == 4  # clamped at min_limit
+
+    def test_clean_round_additive_increase(self):
+        cfg = OverloadConfig(target_delay_s=0.01, increase=4)
+        g = OverloadGovernor(cfg, queue_depth=64)
+        g.register_replica(0)
+        g.on_round(0, 0.05, 8)  # 32
+        g.on_round(0, 0.001, 8)
+        assert g.limit(0) == 36
+        for _ in range(20):
+            g.on_round(0, 0.001, 8)
+        assert g.limit(0) == 64  # capped at the static depth
+
+    def test_backpressure_watermarks(self):
+        cfg = OverloadConfig(target_delay_s=0.01)
+        g = OverloadGovernor(cfg, queue_depth=64)
+        g.register_replica(0)
+        lag = [0]
+        g.add_source(LagSource("x", lambda: lag[0], low=100,
+                               high=200))
+        # below low: no pressure, clean rounds grow
+        g.on_round(0, 0.05, 8)  # decrease -> 32
+        g.on_round(0, 0.001, 8)
+        assert g.limit(0) == 36
+        # between the watermarks: growth pauses, no decrease
+        lag[0] = 150
+        g.on_round(0, 0.001, 8)
+        assert g.limit(0) == 36
+        # at/above high: multiplicative decrease even on clean delay
+        lag[0] = 250
+        g.on_round(0, 0.001, 8)
+        assert g.limit(0) == 18
+        assert g.backpressure() >= 1.0
+
+    def test_duplicate_source_rejected(self):
+        g = OverloadGovernor(OverloadConfig(), queue_depth=8)
+        g.add_source(LagSource("x", lambda: 0, 1, 2))
+        with pytest.raises(ValueError):
+            g.add_source(LagSource("x", lambda: 0, 1, 2))
+        with pytest.raises(ValueError):
+            LagSource("bad", lambda: 0, low=5, high=5)
+
+    def test_brownout_hysteresis(self):
+        cfg = OverloadConfig(target_delay_s=0.01, brownout_enter=2.0,
+                             brownout_exit=0.75, ewma_alpha=1.0)
+        g = OverloadGovernor(cfg, queue_depth=64)
+        g.register_replica(0)
+        assert not g.brownout()
+        g.on_round(0, 0.03, 8)  # ewma = 3x target > enter
+        assert g.brownout()
+        # above exit but below enter: STAYS in brownout (hysteresis)
+        g.on_round(0, 0.012, 8)
+        assert g.brownout()
+        g.on_round(0, 0.001, 8)  # below exit: leaves
+        assert not g.brownout()
+
+    def test_unregistered_rid_falls_back_to_depth(self):
+        g = OverloadGovernor(OverloadConfig(), queue_depth=17)
+        assert g.limit(5) == 17
+
+
+# ==========================================================================
+# Priority shedding: eviction order, inversion impossibility
+# ==========================================================================
+
+
+class TestPriorityShedding:
+    def test_bulk_evicted_before_normal_before_critical(self):
+        nr = make_nr()
+        fe = ServeFrontend(
+            nr, ServeConfig(queue_depth=3, batch_linger_s=0.0),
+            auto_start=False,
+        )
+        fb = fe.submit((SR_SET, 0, 1), priority=BULK)
+        fn = fe.submit((SR_SET, 0, 2), priority=NORMAL)
+        fe.submit((SR_SET, 0, 3), priority=NORMAL)
+        # full: a CRITICAL arrival evicts the BULK op first
+        fe.submit((SR_SET, 0, 4), priority=CRITICAL)
+        exc = fb.exception(1.0)
+        assert isinstance(exc, Overloaded) and exc.evicted
+        assert exc.priority == BULK
+        # full again (no BULK left): next CRITICAL evicts a NORMAL —
+        # the NEWEST queued one of that class, so the older fn stays
+        fe.submit((SR_SET, 0, 5), priority=CRITICAL)
+        assert not fn.done()
+        st = fe.stats()
+        assert st["evicted"] == 2
+        assert st["shed_by_priority"] == {"critical": 0, "normal": 1,
+                                          "bulk": 1}
+        assert st["priority_inversions"] == 0
+        fe.close(drain=False)
+
+    def test_critical_sheds_only_into_critical_queue(self):
+        nr = make_nr()
+        fe = ServeFrontend(
+            nr, ServeConfig(queue_depth=2, batch_linger_s=0.0),
+            auto_start=False,
+        )
+        fe.submit((SR_SET, 0, 1), priority=CRITICAL)
+        fe.submit((SR_SET, 0, 2), priority=CRITICAL)
+        with pytest.raises(Overloaded) as ei:
+            fe.submit((SR_SET, 0, 3), priority=CRITICAL)
+        assert ei.value.priority == CRITICAL
+        # the invariant counter: zero, because nothing lower sat queued
+        assert fe.stats()["priority_inversions"] == 0
+        fe.close(drain=False)
+
+    def test_bulk_sheds_without_evicting(self):
+        nr = make_nr()
+        fe = ServeFrontend(
+            nr, ServeConfig(queue_depth=1, batch_linger_s=0.0),
+            auto_start=False,
+        )
+        fe.submit((SR_SET, 0, 1), priority=NORMAL)
+        with pytest.raises(Overloaded):
+            fe.submit((SR_SET, 0, 2), priority=BULK)
+        assert fe.stats()["evicted"] == 0
+        fe.close(drain=False)
+
+    def test_strict_priority_drain_order(self):
+        nr = make_nr()
+        fe = ServeFrontend(
+            nr, ServeConfig(queue_depth=8, batch_max_ops=8,
+                            batch_linger_s=0.0),
+            auto_start=False,
+        )
+        fb = fe.submit((SR_SET, 0, 10), priority=BULK)
+        fc = fe.submit((SR_SET, 0, 20), priority=CRITICAL)
+        fn = fe.submit((SR_SET, 0, 30), priority=NORMAL)
+        fe.start()
+        fe.drain(5.0)
+        # seqreg fetch-and-set exposes execution order: CRITICAL saw
+        # the initial 0, NORMAL the CRITICAL's write, BULK the NORMAL's
+        assert fc.result(5) == 0
+        assert fn.result(5) == 20
+        assert fb.result(5) == 30
+        fe.close()
+
+    def test_restart_fold_keeps_priority_breakdown(self):
+        # a failover restart retires the queue; its per-priority shed
+        # counts must fold into the aggregates like the totals do, or
+        # stats()['shed'] and sum(shed_by_priority) drift apart
+        nr = make_nr()
+        fe = ServeFrontend(
+            nr, ServeConfig(queue_depth=1, batch_linger_s=0.0,
+                            failover=True),
+            auto_start=False,
+        )
+        fe.submit((SR_SET, 0, 1), priority=NORMAL)
+        with pytest.raises(Overloaded):
+            fe.submit((SR_SET, 0, 2), priority=BULK)  # 1 bulk shed
+        q = fe._queues[0]
+        fe._fail_replica(0, q, RuntimeError("test kill"))
+        fe.restart_replica(0)
+        st = fe.stats()
+        assert st["shed"] == 1
+        assert sum(st["shed_by_priority"].values()) == st["shed"]
+        assert st["shed_by_priority"]["bulk"] == 1
+        fe.close(drain=False)
+
+    def test_bad_priority_rejected(self):
+        nr = make_nr()
+        with ServeFrontend(nr, ServeConfig()) as fe:
+            with pytest.raises(ValueError):
+                fe.submit((SR_SET, 0, 1), priority=7)
+
+
+# ==========================================================================
+# Eager expired sweep at admission (satellite fix)
+# ==========================================================================
+
+
+class TestEagerExpiredSweep:
+    def test_corpses_do_not_shed_live_traffic(self):
+        nr = make_nr()
+        fe = ServeFrontend(
+            nr, ServeConfig(queue_depth=4, batch_linger_s=0.0),
+            auto_start=False,
+        )
+        dead = [fe.submit((SR_SET, 0, i), deadline_s=0.01)
+                for i in range(4)]
+        time.sleep(0.03)  # all four expire in the queue
+        # the queue is "full" of corpses — pre-fix this shed; now the
+        # sweep clears them and the live op is admitted
+        live = fe.submit((SR_SET, 0, 99), deadline_s=10.0)
+        for f in dead:
+            assert isinstance(f.exception(1.0), DeadlineExceeded)
+        assert not live.done()
+        st = fe.stats()
+        assert st["deadline_missed"] == 4
+        assert st["shed"] == 0
+        assert st["queued"] == 1
+        fe.start()
+        assert live.result(5) == 0  # no corpse touched the log
+        fe.close()
+
+    def test_sweep_only_runs_at_the_limit(self):
+        nr = make_nr()
+        fe = ServeFrontend(
+            nr, ServeConfig(queue_depth=8, batch_linger_s=0.0),
+            auto_start=False,
+        )
+        doomed = fe.submit((SR_SET, 0, 1), deadline_s=0.01)
+        time.sleep(0.03)
+        fe.submit((SR_SET, 0, 2))  # room left: no sweep happens
+        assert not doomed.done()
+        assert fe.stats()["queued"] == 2
+        fe.close(drain=False)
+
+
+# ==========================================================================
+# Brownout reads
+# ==========================================================================
+
+
+class TestBrownoutReads:
+    def test_brownout_serves_stale_path_within_bound(self):
+        nr = make_nr()
+        cfg = ServeConfig(
+            queue_depth=64, batch_linger_s=0.0,
+            overload=OverloadConfig(target_delay_s=0.01,
+                                    ewma_alpha=1.0,
+                                    brownout_max_lag=4096),
+        )
+        with ServeFrontend(nr, cfg) as fe:
+            fe.call((SR_SET, 3, 42))
+            # force brownout via a hot round
+            fe.governor.on_round(0, 0.1, 8)
+            assert fe.governor.brownout()
+            v = fe.read((SR_GET, 3), rid=0)
+            assert v == 42  # replica is caught up: stale == fresh
+            st = fe.governor.stats()
+            assert st["brownout_reads"] == 1
+            assert st["max_brownout_lag"] <= 4096
+
+    def test_explicit_min_pos_bypasses_brownout(self):
+        nr = make_nr()
+        cfg = ServeConfig(
+            queue_depth=64, batch_linger_s=0.0,
+            overload=OverloadConfig(target_delay_s=0.01,
+                                    ewma_alpha=1.0),
+        )
+        with ServeFrontend(nr, cfg) as fe:
+            fe.call((SR_SET, 1, 7))
+            fe.governor.on_round(0, 0.1, 8)
+            assert fe.governor.brownout()
+            assert fe.read((SR_GET, 1), rid=0, min_pos=0) == 7
+            # the read-your-writes path never counts as a brownout read
+            assert fe.governor.stats()["brownout_reads"] == 0
+
+    def test_over_bound_falls_back_to_synced_read(self):
+        nr = make_nr()
+        cfg = ServeConfig(
+            queue_depth=64, batch_linger_s=0.0,
+            overload=OverloadConfig(target_delay_s=0.01,
+                                    ewma_alpha=1.0,
+                                    brownout_max_lag=0),
+        )
+        with ServeFrontend(nr, cfg) as fe:
+            fe.call((SR_SET, 2, 5))
+            fe.governor.on_round(0, 0.1, 8)
+            # bound 0: any lag forces the synced path; with the
+            # replica caught up lag == 0 <= 0, so the stale path is
+            # still legal — both serve the correct value
+            assert fe.read((SR_GET, 2), rid=0) == 5
+            assert fe.governor.stats()["max_brownout_lag"] == 0
+
+    def test_execute_stale_reads_current_state(self):
+        nr = make_nr()
+        tok = nr.register(0)
+        nr.execute_mut_batch([(SR_SET, 0, 9)], 0)
+        assert nr.execute_stale((SR_GET, 0), tok) == 9
+        assert nr.read_lag(0) == 0
+        # the atomic bounded form: (value, lag) within the bound
+        assert nr.execute_stale_bounded((SR_GET, 0), tok, 10) == (9, 0)
+
+    def test_linger_at_or_above_target_rejected(self):
+        # a linger >= the AIMD setpoint would read an idle frontend
+        # as congested (the delay signal includes the linger)
+        with pytest.raises(ValueError):
+            ServeConfig(batch_linger_s=0.02,
+                        overload=OverloadConfig(target_delay_s=0.01))
+
+
+# ==========================================================================
+# Circuit breaker + per-cause retry accounting
+# ==========================================================================
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_and_half_open_probe(self):
+        b = CircuitBreaker(failure_threshold=3, cooldown_s=0.05)
+        for _ in range(3):
+            b.before_call()
+            b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(CircuitOpen) as ei:
+            b.before_call()
+        assert ei.value.retry_after_s > 0
+        time.sleep(0.06)
+        b.before_call()  # the half-open probe is admitted
+        assert b.state == "half-open"
+        with pytest.raises(CircuitOpen):
+            b.before_call()  # only ONE probe at a time
+        b.record_success()
+        assert b.state == "closed"
+        b.before_call()  # closed again: calls flow
+
+    def test_half_open_failure_reopens(self):
+        b = CircuitBreaker(failure_threshold=2, cooldown_s=0.05)
+        for _ in range(2):
+            b.record_failure()
+        time.sleep(0.06)
+        b.before_call()
+        b.record_failure()  # the probe failed
+        assert b.state == "open"
+        with pytest.raises(CircuitOpen):
+            b.before_call()
+
+    def test_success_resets_consecutive_count(self):
+        b = CircuitBreaker(failure_threshold=3, cooldown_s=1.0)
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            CircuitBreaker(failure_threshold=0)
+        with pytest.raises(ValueError):
+            CircuitBreaker(cooldown_s=0)
+
+    def test_lost_probe_lease_expires(self):
+        # a probe whose caller never reports back (crash, untyped
+        # error outside the breaker's accounting) must not wedge the
+        # circuit half-open forever: the probe holds a lease one
+        # cool-down long, then the next caller takes it over
+        b = CircuitBreaker(failure_threshold=1, cooldown_s=0.05)
+        b.record_failure()  # open
+        time.sleep(0.06)
+        b.before_call()  # probe admitted; caller vanishes silently
+        with pytest.raises(CircuitOpen):
+            b.before_call()  # lease still held
+        time.sleep(0.06)  # lease expired
+        b.before_call()  # taken over
+        b.record_success()
+        assert b.state == "closed"
+
+
+class _FlakyFrontend:
+    """Stub: raises the scripted errors, then succeeds."""
+
+    def __init__(self, errors):
+        self.errors = list(errors)
+        self.calls = 0
+
+    def call(self, op, rid=0, deadline_s=None, timeout=None,
+             **kwargs):
+        self.calls += 1
+        if self.errors:
+            raise self.errors.pop(0)
+        return 42
+
+    def healthy_rids(self):
+        return [0, 1]
+
+
+class TestRetryByCause:
+    def _counters(self):
+        reg = get_registry()
+        return {c: reg.counter(f"serve.retry.{c}").value
+                for c in ("overloaded", "replica_failed",
+                          "circuit_open")}
+
+    def test_counters_split_by_cause(self):
+        reg = get_registry()
+        was = reg.enabled
+        reg.enable()
+        try:
+            before = self._counters()
+            fe = _FlakyFrontend([Overloaded(0, 4),
+                                 ReplicaFailed(0, None, False)])
+            policy = RetryPolicy(max_attempts=5,
+                                 base_backoff_s=0.0001,
+                                 max_backoff_s=0.001)
+            assert call_with_retry(fe, (SR_SET, 0, 1),
+                                   policy=policy) == 42
+            after = self._counters()
+            assert after["overloaded"] - before["overloaded"] == 1
+            assert (after["replica_failed"]
+                    - before["replica_failed"]) == 1
+            assert after["circuit_open"] == before["circuit_open"]
+        finally:
+            if not was:
+                reg.disable()
+
+    def test_breaker_wired_through_retry(self):
+        reg = get_registry()
+        was = reg.enabled
+        reg.enable()
+        try:
+            before = self._counters()
+            # enough sheds to trip the breaker, then success: the
+            # retry loop must ride out the cool-down (CircuitOpen is
+            # transient) and land the op
+            fe = _FlakyFrontend([Overloaded(0, 4)] * 3)
+            b = CircuitBreaker(failure_threshold=2, cooldown_s=0.02)
+            policy = RetryPolicy(max_attempts=10,
+                                 base_backoff_s=0.0001,
+                                 max_backoff_s=0.001)
+            assert call_with_retry(fe, (SR_SET, 0, 1), policy=policy,
+                                   breaker=b) == 42
+            after = self._counters()
+            assert after["overloaded"] > before["overloaded"]
+            assert after["circuit_open"] > before["circuit_open"]
+            assert b.state == "closed"
+        finally:
+            if not was:
+                reg.disable()
+
+    def test_maybe_executed_still_propagates_with_breaker(self):
+        fe = _FlakyFrontend([ReplicaFailed(0, None,
+                                           maybe_executed=True)])
+        with pytest.raises(ReplicaFailed):
+            call_with_retry(fe, (SR_SET, 0, 1),
+                            breaker=CircuitBreaker())
+
+    def test_non_transient_outcome_reported_to_breaker(self):
+        # a call ending in DeadlineExceeded (outside the retry loop's
+        # transient set) must still report to the breaker — a probe
+        # that exits silently would strand the circuit half-open
+        fe = _FlakyFrontend([DeadlineExceeded(0, 0.01)])
+        b = CircuitBreaker(failure_threshold=1, cooldown_s=10.0)
+        with pytest.raises(DeadlineExceeded):
+            call_with_retry(fe, (SR_SET, 0, 1), breaker=b)
+        assert b.state == "open"
+        assert b.stats()["consecutive_failures"] == 1
+
+
+# ==========================================================================
+# Backpressure wiring: WAL fsync lag, shipper lag
+# ==========================================================================
+
+
+class TestBackpressureWiring:
+    def test_wal_fsync_lag_export(self, tmp_path):
+        from node_replication_tpu.durable.wal import WriteAheadLog
+
+        wal = WriteAheadLog(str(tmp_path / "wal"), policy="batch",
+                            arg_width=2)
+        assert wal.fsync_lag() == 0
+        wal.append(0, [(1, 0, 5), (1, 1, 6)])
+        assert wal.fsync_lag() == 2
+        wal.sync()
+        assert wal.fsync_lag() == 0
+        wal.close()
+
+    def test_frontend_auto_registers_wal_source(self, tmp_path):
+        from node_replication_tpu.durable.wal import WriteAheadLog
+
+        nr = make_nr()
+        wal = WriteAheadLog(str(tmp_path / "wal"), policy="batch",
+                            arg_width=nr.spec.arg_width)
+        nr.attach_wal(wal)
+        cfg = ServeConfig(batch_linger_s=0.0,
+                          overload=OverloadConfig())
+        with ServeFrontend(nr, cfg) as fe:
+            assert "wal-fsync" in fe.governor.stats()["sources"]
+        nr.detach_wal().close()
+
+    def test_wal_attached_after_construction_still_wired(self,
+                                                         tmp_path):
+        # the PR-5 flow: build the frontend first, attach_wal later —
+        # the fsync-lag leg must resolve the WAL at poll time, not
+        # snapshot None at construction
+        from node_replication_tpu.durable.wal import WriteAheadLog
+
+        nr = make_nr()
+        cfg = ServeConfig(
+            batch_linger_s=0.0,
+            overload=OverloadConfig(),
+            wal_lag_low=1, wal_lag_high=4,
+        )
+        with ServeFrontend(nr, cfg) as fe:
+            assert "wal-fsync" in fe.governor.stats()["sources"]
+            assert fe.governor.backpressure() == 0.0  # no WAL yet
+            wal = WriteAheadLog(str(tmp_path / "wal"), policy="none",
+                                arg_width=nr.spec.arg_width)
+            nr.attach_wal(wal)
+            for i in range(6):
+                fe.call((SR_SET, 0, i + 1))
+            # 6 journaled, none fsynced: past the high watermark
+            assert fe.governor.backpressure() >= 1.0
+        nr.detach_wal().close()
+
+    def test_add_backpressure_source_requires_governor(self):
+        nr = make_nr()
+        with ServeFrontend(nr, ServeConfig()) as fe:
+            with pytest.raises(ValueError):
+                fe.add_backpressure_source("x", lambda: 0, 1, 2)
+
+    def test_high_lag_clamps_admission(self):
+        nr = make_nr()
+        cfg = ServeConfig(
+            queue_depth=64, batch_linger_s=0.0,
+            overload=OverloadConfig(target_delay_s=10.0,
+                                    min_limit=4),
+        )
+        with ServeFrontend(nr, cfg) as fe:
+            lag = [10_000]
+            fe.add_backpressure_source("ship", lambda: lag[0],
+                                       low=100, high=1000)
+            # clean delay, but the source is past its high watermark:
+            # every round shrinks admission toward the floor
+            for _ in range(10):
+                fe.governor.on_round(0, 0.0, 8)
+            assert fe.governor.limit(0) == 4
+            lag[0] = 0  # backlog drained: admission recovers
+            for _ in range(20):
+                fe.governor.on_round(0, 0.0, 8)
+            assert fe.governor.limit(0) == 64
+
+
+# ==========================================================================
+# End to end: adaptive admission under a real burst
+# ==========================================================================
+
+
+class TestAdaptiveEndToEnd:
+    def test_no_loss_no_inversion_under_burst(self):
+        nr = make_nr(regs=8)
+        cfg = ServeConfig(
+            queue_depth=16, batch_max_ops=8, batch_linger_s=0.0,
+            overload=OverloadConfig(target_delay_s=0.002,
+                                    min_limit=2),
+        )
+        outcomes = {"ok": 0, "shed": 0, "evicted": 0}
+        with ServeFrontend(nr, cfg) as fe:
+            futs = []
+            for i in range(200):
+                prio = (CRITICAL, NORMAL, BULK)[i % 3]
+                try:
+                    futs.append(fe.submit((SR_SET, i % 8, i + 1),
+                                          priority=prio))
+                except Overloaded:
+                    outcomes["shed"] += 1
+            fe.drain(10.0)
+            for f in futs:
+                exc = f.exception(10.0)
+                if exc is None:
+                    outcomes["ok"] += 1
+                elif isinstance(exc, Overloaded) and exc.evicted:
+                    outcomes["evicted"] += 1
+                else:  # pragma: no cover - would fail the assert below
+                    raise AssertionError(f"unexpected {exc!r}")
+            st = fe.stats()
+        assert outcomes["ok"] + outcomes["evicted"] == len(futs)
+        assert st["priority_inversions"] == 0
+        assert st["accepted"] == len(futs)
+        assert st["completed"] == outcomes["ok"]
+        # log effect matches acks exactly: tail == completed ops
+        import numpy as np
+
+        assert int(np.asarray(nr.log.tail)) == outcomes["ok"]
+
+    def test_concurrent_clients_with_breakers(self):
+        nr = make_nr(regs=4)
+        cfg = ServeConfig(
+            queue_depth=8, batch_max_ops=4, batch_linger_s=0.0,
+            overload=OverloadConfig(target_delay_s=0.001,
+                                    min_limit=2),
+        )
+        errs: list = []
+
+        def client(fe, c):
+            b = CircuitBreaker(failure_threshold=4, cooldown_s=0.01)
+            policy = RetryPolicy(max_attempts=12,
+                                 base_backoff_s=0.0005,
+                                 max_backoff_s=0.01)
+            prev = 0
+            for i in range(50):
+                try:
+                    resp = call_with_retry(
+                        fe, (SR_SET, c, i + 1), policy=policy,
+                        breaker=b, priority=(i % 3),
+                    )
+                except (Overloaded, CircuitOpen):
+                    continue  # budget exhausted: op provably shed
+                if resp != prev:
+                    errs.append((c, i, resp, prev))
+                prev = i + 1
+
+        with ServeFrontend(nr, cfg) as fe:
+            ths = [threading.Thread(target=client, args=(fe, c))
+                   for c in range(4)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+        assert not errs, errs[:5]

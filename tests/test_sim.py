@@ -250,6 +250,54 @@ class TestProperties:
         assert CaseSpec.from_dict(spec.as_dict()) == spec
 
 
+class TestOverloadBursts:
+    def test_generated_burst_case_clean_and_deterministic(self):
+        # overload bursts (ISSUE 9): a generated serve/NR case with
+        # burst steps holds shed-honesty / priority-inversion /
+        # resp-diff, and replays byte-identically
+        spec = _find_spec(
+            lambda s: any(st[0] == "burst" for st in s.steps),
+            flavors=("serve",), wrappers=("nr",),
+        )
+        r1 = run_case(spec)
+        assert r1.ok, [v.as_dict() for v in r1.violations]
+        r2 = run_case(spec)
+        assert r1.digest == r2.digest
+        evs = [e for e in r1.events if e[1] == "burst"]
+        assert evs
+
+    def test_crafted_burst_sheds_bulk_completes_critical(self):
+        # 6 BULK fill the burst frontend's depth-6 queue, then 6
+        # CRITICAL arrivals evict them one by one: every CRITICAL
+        # completes, every BULK rejects, the log holds exactly the
+        # completed set (shed-honesty), and no priority inversion
+        burst = (
+            [[2, [1, k, 100 + k]] for k in range(6)]
+            + [[0, [1, k, 200 + k]] for k in range(6)]
+        )
+        spec = CaseSpec(
+            seed=0, model="hashmap", wrapper="nr", flavor="serve",
+            n_replicas=2, nlogs=1, steps=[["burst", burst], ["sync"]],
+        )
+        res = run_case(spec)
+        assert res.ok, [v.as_dict() for v in res.violations]
+        ev = [e for e in res.events if e[1] == "burst"][0]
+        outcomes = [o[1] for o in ev[2]["outcomes"]]
+        assert outcomes[:6] == ["evicted"] * 6
+        assert outcomes[6:] == ["completed"] * 6
+        assert ev[2]["applied"] == 6
+        assert ev[2]["evicted"] == 6
+
+    def test_non_serve_flavors_unchanged_by_burst_generation(self):
+        # the fresh-rng guarantee: crash/repl schedules (and their
+        # canary seeds) are byte-identical to the pre-overload
+        # generator — no burst step ever appears there
+        for flavor in ("wrapper", "crash", "repl"):
+            for seed in range(4):
+                spec = generate_case(seed, flavors=(flavor,))
+                assert not any(st[0] == "burst" for st in spec.steps)
+
+
 class TestCanaries:
     def test_reclaim_ignores_pins_is_caught_and_shrinks(self):
         # the reclaim-vs-ship race PR 6 closed, re-opened: a repl
