@@ -237,6 +237,151 @@ def serve_main(args) -> int:
     return 0
 
 
+def mesh_main(args) -> int:
+    """`--mesh`: the 1→N-device scaling curve (ISSUE 10).
+
+    Runs the flagship hashmap 50/50 configuration at every requested
+    device count — 1 device through the plain un-sharded step (the
+    exact flagship program), N devices through `ShardedRunner`
+    (replica axis under `NamedSharding(mesh, P('replica'))`,
+    `parallel/mesh.py`) — and emits the curve as one JSON line plus
+    `mesh_benchmarks.csv` rows (devices, throughput, scaling_x,
+    efficiency — mkbench `mesh_rows`/`append_mesh_csv`).
+
+    Hard gates (exit 1):
+
+    - **bit-identity** — before each point is timed, the sharded fleet
+      replays fixed verification steps and its states must equal the
+      1-device fleet's bit-for-bit (placement changes speed, never
+      results);
+    - **flagship stays honest** — on real TPU devices the 1-device
+      point must stay within `--mesh-baseline-tolerance` of
+      `--mesh-baseline` (default: the r05 6.94 G dispatches/s
+      flagship), so the mesh work cannot silently regress the
+      single-chip number the scaling claims are relative to. Skipped
+      on CPU/forced-host meshes, where the absolute number is
+      meaningless (`--mesh-baseline 0` disables it everywhere).
+    """
+    from node_replication_tpu.harness.mkbench import (
+        append_mesh_csv,
+        measure_mesh,
+        mesh_rows,
+    )
+    from node_replication_tpu.models import (
+        HM_GET,
+        HM_PUT,
+        make_hashmap,
+    )
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    R = args.replicas
+    failures: list[str] = []
+    if args.mesh_devices:
+        counts = sorted({int(x)
+                         for x in args.mesh_devices.split(",")})
+        for c in counts:
+            if c > n_dev:
+                failures.append(f"{c} devices requested, {n_dev} "
+                                f"available")
+            if c < 1 or (R % c):
+                failures.append(f"R={R} not divisible by {c} devices")
+    else:
+        counts = sorted({
+            d for d in {1, 2, 4, 8, 16, 32, 64, 128, n_dev}
+            if 1 <= d <= n_dev and R % d == 0
+        })
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    if counts[0] != 1:
+        counts = [1] + counts  # the curve is relative to 1 device
+
+    points = measure_mesh(
+        lambda: make_hashmap(args.keys), counts, R,
+        args.writes_per_replica, args.reads_per_replica,
+        keyspace=args.keys, duration_s=args.mesh_duration,
+        seed=args.seed, wr_opcode=HM_PUT, rd_opcode=HM_GET,
+    )
+    for p in points:
+        if not p.bit_identical:
+            failures.append(
+                f"{p.devices}-device fleet is NOT bit-identical to "
+                f"the 1-device reference after the verification "
+                f"steps — the curve would compare different "
+                f"computations"
+            )
+
+    single_dps = points[0].result.mops * 1e6
+    platform = devices[0].platform.lower()
+    gate_active = args.mesh_baseline > 0 and platform == "tpu"
+    baseline_ratio = (
+        single_dps / args.mesh_baseline if args.mesh_baseline else None
+    )
+    if gate_active:
+        tol = args.mesh_baseline_tolerance
+        if abs(single_dps - args.mesh_baseline) > \
+                tol * args.mesh_baseline:
+            failures.append(
+                f"1-device flagship throughput {single_dps:.3g} "
+                f"dispatches/s is outside ±{tol * 100:.0f}% of the "
+                f"baseline {args.mesh_baseline:.3g} (mesh work "
+                f"regressed — or improved past — the single-chip "
+                f"number; re-baseline deliberately)"
+            )
+
+    batch = args.writes_per_replica + args.reads_per_replica
+    rows = mesh_rows("bench", points, batch=batch, keys=args.keys,
+                     replicas=R)
+    append_mesh_csv(args.serve_out, rows)
+    base = points[0].result.mops or 1e-9
+    curve = [{
+        "devices": p.devices,
+        "throughput_dps": round(p.result.mops * 1e6, 1),
+        "scaling_x": round(p.result.mops / base, 4),
+        "efficiency": round(p.result.mops / base / p.devices, 4),
+        "spread_pct": round(p.spread_pct, 2),
+        "bit_identical": p.bit_identical,
+    } for p in points]
+    print(json.dumps({
+        "metric": "mesh_scaling_curve",
+        "value": curve[-1]["scaling_x"],
+        "unit": "x_vs_1_device",
+        "replicas": R,
+        "keys": args.keys,
+        "device_counts": counts,
+        "device_kind": devices[0].device_kind,
+        "platform": platform,
+        "single_device_dps": round(single_dps, 1),
+        "baseline_dps": args.mesh_baseline,
+        "baseline_ratio": (
+            round(baseline_ratio, 4)
+            if baseline_ratio is not None else None
+        ),
+        "baseline_gate": (
+            "enforced" if gate_active else "skipped (non-TPU)"
+        ),
+        "curve": curve,
+        "bit_identical": all(p.bit_identical for p in points),
+    }))
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# mesh OK: 1→{counts[-1]} device(s), "
+        + " ".join(
+            f"{c['devices']}d={c['throughput_dps']:.3g}dps"
+            f"({c['efficiency']:.0%})" for c in curve
+        )
+        + f"; bit-identical at every width; baseline gate "
+          f"{'enforced' if gate_active else 'skipped (non-TPU)'}",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def overload_main(args) -> int:
     """`--overload`: the graceful-degradation gate (ISSUE 9).
 
@@ -1699,6 +1844,35 @@ def main():
                                "transients — 8 puts the window near "
                                "1s on a typical CPU runner")
 
+    mesh = p.add_argument_group(
+        "mesh", "mesh scaling benchmark (--mesh): the flagship "
+                "hashmap 50/50 config at 1→N devices with the "
+                "replica axis sharded over the mesh; exits 1 unless "
+                "every width is bit-identical to the 1-device fleet "
+                "and (on TPU) the 1-device point stays within "
+                "tolerance of the committed flagship baseline")
+    mesh.add_argument("--mesh", action="store_true",
+                      help="run the mesh scaling curve instead of the "
+                           "replay flagship (reuses --replicas/--keys/"
+                           "--writes-per-replica/--reads-per-replica)")
+    mesh.add_argument("--mesh-devices", default=None,
+                      help="comma-separated device counts to measure "
+                           "(default: powers of two dividing "
+                           "--replicas, up to every visible device; "
+                           "1 is always included as the curve base)")
+    mesh.add_argument("--mesh-duration", type=float, default=1.0,
+                      help="seconds of timed stepping per point")
+    mesh.add_argument("--mesh-baseline", type=float, default=6.94e9,
+                      help="flagship dispatches/s the 1-device point "
+                           "is gated against on TPU (r05 committed "
+                           "number; 0 disables the gate)")
+    mesh.add_argument("--mesh-baseline-tolerance", type=float,
+                      default=0.15,
+                      help="allowed relative deviation from "
+                           "--mesh-baseline (covers the r05 spread "
+                           "plus methodology skew between the "
+                           "flagship repeats loop and the curve's "
+                           "chunked measurement)")
     chaos = p.add_argument_group(
         "chaos", "fault-injection benchmark (--chaos): the closed-loop "
                  "sequence-verified serve run with a FaultPlan killing "
@@ -1787,9 +1961,9 @@ def main():
     if args.max_attempts < 1:
         p.error("--max-attempts must be >= 1")
     if sum(map(bool, (args.chaos, args.serve, args.crash,
-                      args.follower, args.overload))) > 1:
-        p.error("--chaos, --serve, --crash, --follower and "
-                "--overload are mutually exclusive")
+                      args.follower, args.overload, args.mesh))) > 1:
+        p.error("--chaos, --serve, --crash, --follower, --overload "
+                "and --mesh are mutually exclusive")
     if args.crash_child:
         if not args.crash_dir:
             p.error("--crash-child requires --crash-dir")
@@ -1809,6 +1983,8 @@ def main():
         sys.exit(serve_main(args))
     if args.overload:
         sys.exit(overload_main(args))
+    if args.mesh:
+        sys.exit(mesh_main(args))
     if args.pallas:
         if args.path not in ("auto", "pallas"):
             p.error(f"--pallas conflicts with --path {args.path}")

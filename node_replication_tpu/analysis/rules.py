@@ -1379,3 +1379,101 @@ def unbounded_growth_in_subsystem(
                 f"), drain it, or gate the append on a depth/"
                 f"watermark the admission controller enforces",
             )
+
+
+# --------------------------------------------------------------------------
+# host-transfer-in-sharded-path
+# --------------------------------------------------------------------------
+
+#: package directories whose exec paths run over mesh-sharded state
+#: (the replica axis lives across devices there — parallel/mesh.py)
+_SHARDED_PATH_DIRS = ("core", "parallel")
+
+#: function names that ARE the exec path: replay rounds, catch-up
+#: loops, fused steps, and the explicit-collective programs
+_SHARDED_FN_RE = re.compile(r"(exec|catchup|replay|shmap|step)")
+
+#: identifier fragments that denote mesh-sharded state leaves: replica
+#: states and the log's ring arrays. Cursor readbacks (ltails/tail/
+#: head/ctail — a few hundred bytes) are the sanctioned host syncs of
+#: the exec loop and never match.
+_SHARDED_STATE_TOKENS = ("states", "opcodes")
+
+_TRANSFER_DOTTED = {
+    "numpy.asarray": "np.asarray gathers the sharded array to host",
+    "numpy.array": "np.array gathers the sharded array to host",
+    "jax.device_get": "jax.device_get gathers the sharded array "
+                      "to host",
+}
+
+
+def _mentions_sharded_state(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and any(tok in name for tok in _SHARDED_STATE_TOKENS):
+            return True
+    return False
+
+
+@rule(
+    "host-transfer-in-sharded-path", WARNING,
+    "np.asarray/.item()/device_get on mesh-sharded state in a "
+    "core//parallel/ exec path",
+)
+def host_transfer_in_sharded_path(
+        mod: ModuleInfo, project: Project) -> Iterator[Diagnostic]:
+    """The mesh-fleet placement contract (`parallel/mesh.py:place`):
+    replica states and the log's ring arrays live sharded across the
+    mesh's devices, so a host materialization of them inside an exec
+    path (`_exec_round`, catch-up loops, the shard_map/ring programs,
+    the fused steps) is an ALL-GATHER of the whole fleet through the
+    host — O(R x state) bytes over PCIe/ICI per round, exactly the
+    transfer the sharding exists to avoid, and silently correct so no
+    test catches it. Scoped to core/ and parallel/ functions whose
+    name marks them as exec-path (exec/catchup/replay/shmap/step);
+    flags `np.asarray`/`np.array`/`jax.device_get` calls and `.item()`
+    readbacks whose operand mentions a sharded-state leaf (`states`,
+    `opcodes`). Cursor readbacks (`ltails`/`tail`/`head`/`ctail`) are
+    the exec loop's sanctioned host syncs and stay clean; deliberate
+    host bridges (`ring_slice`, checkpointing, `verify`) live outside
+    the scoped function names."""
+    parts = re.split(r"[\\/]+", mod.path)
+    if not any(d in parts[:-1] for d in _SHARDED_PATH_DIRS):
+        return
+    # collect the scoped functions (by name), then walk each body
+    for fn_node in ast.walk(mod.tree):
+        if not isinstance(fn_node,
+                          (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _SHARDED_FN_RE.search(fn_node.name):
+            continue
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mod.dotted(node.func)
+            if d in _TRANSFER_DOTTED:
+                if not (node.args
+                        and _mentions_sharded_state(node.args[0])):
+                    continue
+                yield _diag(
+                    mod, node, "host-transfer-in-sharded-path",
+                    f"{fn_node.name}: {_TRANSFER_DOTTED[d]} inside a "
+                    f"sharded exec path — on a mesh fleet this "
+                    f"gathers every device's shard through the host "
+                    f"each round; keep the state on device (cursor "
+                    f"readbacks are fine)",
+                )
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "item"
+                  and _mentions_sharded_state(node.func.value)):
+                yield _diag(
+                    mod, node, "host-transfer-in-sharded-path",
+                    f"{fn_node.name}: .item() on mesh-sharded state "
+                    f"inside a sharded exec path — a cross-device "
+                    f"readback per call; keep the value symbolic or "
+                    f"read back cursors instead",
+                )

@@ -63,6 +63,7 @@ class MultiLogReplicated:
         gc_slack: int = 128,
         exec_window: int = 128,
         gc_callback: Callable[[int, int], None] | None = None,
+        mesh=None,
     ):
         self.spec = MultiLogSpec(
             nlogs=nlogs,
@@ -78,6 +79,54 @@ class MultiLogReplicated:
 
         self.ml = multilog_init(self.spec)
         self.states = replicate_state(dispatch.init_state(), n_replicas)
+
+        # mesh placement (the NodeReplicated(mesh=) twin): the stacked
+        # log rings shard over the mesh 'log' axis, replica states (and
+        # the [L, R] ltails' replica dimension) over 'replica'
+        # (`parallel/mesh.py:place` handles MultiLogState). Exec/append
+        # jits are unchanged — GSPMD propagates the placed inputs'
+        # shardings and inserts the cross-column collectives (the
+        # annotation tier; the ShardedCnrRunner proves the placement on
+        # the fused step, this wires it into the stateful wrapper).
+        self.mesh = None
+        self._mesh_shards = 0
+        self._mesh_rep_shards = 1
+        if mesh is not None:
+            from jax.sharding import Mesh
+
+            from node_replication_tpu.parallel.mesh import (
+                announce_placement,
+                place,
+            )
+
+            if not isinstance(mesh, Mesh) or not {
+                "replica", "log"
+            } <= set(mesh.axis_names):
+                # the placement spec trees name both axes — a partial
+                # mesh would die inside NamedSharding with an opaque
+                # resource-axis error instead of this
+                raise ValueError(
+                    f"MultiLogReplicated needs a ('replica', 'log') "
+                    f"Mesh (parallel/mesh.py:make_mesh); got "
+                    f"{mesh!r}"
+                )
+            shape = dict(mesh.shape)
+            if n_replicas % shape["replica"]:
+                raise ValueError(
+                    f"R={n_replicas} replicas cannot shard over "
+                    f"{shape['replica']} mesh rows"
+                )
+            if nlogs % shape["log"]:
+                raise ValueError(
+                    f"L={nlogs} logs cannot shard over "
+                    f"{shape['log']} mesh columns"
+                )
+            self.mesh = mesh
+            self._mesh_shards = int(np.prod(mesh.devices.shape))
+            self._mesh_rep_shards = shape["replica"]
+            announce_placement(mesh, n_replicas, "MultiLogReplicated",
+                               "gspmd")
+            self.ml, self.states = place(self.ml, self.states, mesh)
 
         # Combiner lock (`replica._locked`): one combiner pass at a
         # time across all logs; reentrant so watchdog gc_callbacks can
@@ -103,6 +152,9 @@ class MultiLogReplicated:
         self._m_batch = reg.histogram("cnr.combine.batch_size",
                                       buckets=COUNT_BUCKETS)
         self._m_stalls = reg.counter("cnr.watchdog.stalls")
+        if self.mesh is not None:
+            self._m_mesh_round = reg.counter("cnr.exec.mesh.gspmd")
+            self._m_mesh_sync_bytes = reg.counter("mesh.sync_bytes")
 
         spec, d = self.spec, dispatch
 
@@ -161,6 +213,20 @@ class MultiLogReplicated:
     @property
     def nlogs(self) -> int:
         return self.spec.nlogs
+
+    def replica_device(self, rid: int):
+        """First device of the mesh row hosting replica `rid`'s state
+        shard (None when un-meshed) — the NodeReplicated twin the
+        serve frontend's worker→device map consumes. A CNR replica's
+        state lives on one 'replica' row but its per-log ring columns
+        span that row, so the row's first device stands for the
+        shard's home."""
+        if self.mesh is None:
+            return None
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        row = rid // (self.n_replicas // self._mesh_rep_shards)
+        return self.mesh.devices[row].flat[0]
 
     @_locked
     def register(self, rid: int = 0) -> ReplicaToken:
@@ -475,6 +541,13 @@ class MultiLogReplicated:
                 "rounds": self._exec_rounds,
                 "idle_rounds": self._idle_rounds,
             },
+            "mesh": (
+                None if self.mesh is None else {
+                    "devices": self._mesh_shards,
+                    "tier": "gspmd",
+                    "shape": dict(self.mesh.shape),
+                }
+            ),
             "metrics": get_registry().snapshot(),
         }
 
@@ -506,6 +579,10 @@ class MultiLogReplicated:
         )
         lt_after = np.asarray(self.ml.ltails)[log_idx]
         resps_np = np.asarray(resps)
+        if self.mesh is not None:
+            self._m_mesh_round.inc()
+            self._m_mesh_sync_bytes.inc(resps_np.nbytes + cur.nbytes
+                                        + lt_after.nbytes)
         for r in range(self.n_replicas):
             q = self._inflight.get((r, log_idx))
             if not q:

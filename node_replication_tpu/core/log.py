@@ -29,6 +29,16 @@ TPU-native re-design of the reference's lock-free MPMC ring
 Logical positions (`head`/`tail`/`ctail`/`ltails`) are monotonically
 increasing int64 scalars; the physical slot is `pos & (L-1)` with L a power
 of two (`nr/src/log.rs:194-196`, `527-530`).
+
+Mesh placement: every function here is sharding-agnostic — under the
+canonical mesh placement (`parallel/mesh.py:place`: ring arrays and
+scalar cursors replicated, `ltails` and the replica axis of `states`
+sharded over 'replica') the same programs run across a TPU mesh with
+GSPMD inserting the collectives, and `parallel/collectives.py:
+make_shmap_exec` is the explicit-collective twin of `log_exec_all`
+(same lattice bookkeeping as `pmax`/`pmin` over ICI). The sharded and
+unsharded programs are differentially pinned bit-identical in
+tests/test_mesh_fleet.py.
 """
 
 from __future__ import annotations
@@ -59,6 +69,10 @@ _m_engine_scan = get_registry().counter("log.engine.scan")
 _m_engine_window = get_registry().counter("log.engine.window_apply")
 _m_engine_union = get_registry().counter("log.engine.union_plan")
 _m_idle_skips = get_registry().counter("log.engine.idle_skip")
+# mesh tier: shard_map exec programs built by parallel/collectives.py
+# (make_shmap_exec — counted per build, like the per-trace counters
+# above; per-ROUND mesh usage is the wrapper's nr.exec.mesh.* family)
+_m_engine_shmap = get_registry().counter("log.engine.shmap")
 
 # Default number of log entries. The reference defaults to 32 MiB of 64-byte
 # entries = 2^19 slots "based on the ASPLOS 2017 paper" (`nr/src/log.rs:19-22`);

@@ -23,6 +23,15 @@ TPU-first re-design (SURVEY.md §7 "CNR"):
 - Reads sync only their mapped log (`cnr/src/replica.rs:599-617`);
   `sync_log` targets one log (`cnr/src/replica.rs:579-597`).
 
+Mesh placement: `MultiLogState` is the pytree `parallel/mesh.py:place`
+shards over a ('replica', 'log') mesh — rings and per-log cursors on
+their 'log' column, replica states (and the ltails replica dimension)
+over 'replica' rows — and every exec path here is sharding-agnostic:
+`MultiLogReplicated(mesh=...)` and `ShardedCnrRunner` run these same
+programs with GSPMD inserting the collectives (the annotation tier;
+tests/test_mesh_fleet.py pins the wrapper bit-identical to the
+un-meshed twin).
+
 Replay layout: `multilog_exec_all` vmaps the single-log scan over
 (log × replica). Because ops on different logs commute by contract, applying
 each log's span to disjoint *state partitions* is exact. The bundled

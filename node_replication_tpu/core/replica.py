@@ -49,6 +49,7 @@ import numpy as np
 from node_replication_tpu.core.log import (
     LogSpec,
     WARN_ROUNDS,
+    gather_window,
     log_append,
     log_catchup_all,
     log_exec_all,
@@ -217,6 +218,8 @@ class NodeReplicated:
         gc_callback: Callable[[int, int], None] | None = None,
         debug: bool | None = None,
         engine: str = "auto",
+        mesh=None,
+        collectives: str = "auto",
     ):
         kw = {}
         if log_entries is not None:
@@ -329,12 +332,130 @@ class NodeReplicated:
         # per-round engine usage (host truth for the wrapper; core/log.py
         # counts per-trace selections of the inner tiers)
         self._m_engine = reg.counter(f"nr.exec.engine.{self.engine}")
+
+        # ---- mesh placement (parallel/): shard the replica axis -----
+        # `mesh` puts the fleet across devices: states (and ltails)
+        # shard over the mesh's 'replica' axis, the log's ring arrays
+        # and scalar cursors replicate (`parallel/mesh.py:place` — the
+        # NamedSharding(mesh, P('replica')) batch-dim pattern). Accepts
+        # a jax Mesh, a device count (first N devices), or a
+        # ReplicaStrategy. `collectives` picks the cross-device exec
+        # tier: 'shmap' = the explicit-collective shard_map exec
+        # (`parallel/collectives.py:make_shmap_exec`, pmax/pmin lattice
+        # over ICI), 'gspmd' = the annotation path (the exact
+        # single-device programs, GSPMD inserts the collectives from
+        # the placed inputs), 'auto' = shmap for scan-engine fleets,
+        # gspmd when the combined engine (whose union-plan economics
+        # GSPMD preserves) or debug checks are in play. Both tiers are
+        # differentially pinned bit-identical to the un-meshed wrapper
+        # (tests/test_mesh_fleet.py). mesh=None is byte-identical to
+        # the pre-mesh wrapper: no placement, no extra branches traced.
+        if collectives not in ("auto", "shmap", "gspmd"):
+            raise ValueError(f"unknown collectives tier {collectives!r}")
+        self.mesh = None
+        self._mesh_shards = 0
+        self._mesh_tier = None
+        self._ring_rounds = 0
+        if mesh is not None:
+            from node_replication_tpu.parallel.mesh import (
+                ReplicaStrategy,
+                announce_placement,
+                replica_mesh,
+            )
+
+            if isinstance(mesh, int):
+                mesh = replica_mesh(mesh)
+            elif isinstance(mesh, ReplicaStrategy):
+                mesh = replica_mesh(strategy=mesh)
+            if "replica" not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh {mesh.axis_names} has no 'replica' axis"
+                )
+            shards = mesh.shape["replica"]
+            if n_replicas % shards:
+                raise ValueError(
+                    f"R={n_replicas} replicas cannot shard over "
+                    f"{shards} mesh shards"
+                )
+            if collectives == "auto":
+                tier = (
+                    "gspmd"
+                    if (self.engine == "combined" or self.debug)
+                    else "shmap"
+                )
+            else:
+                tier = collectives
+            if tier == "shmap" and self.debug:
+                raise ValueError(
+                    "collectives='shmap' has no checkify twin; use "
+                    "the gspmd tier (or debug=False) on a mesh"
+                )
+            self.mesh = mesh
+            self._mesh_shards = shards
+            self._mesh_tier = tier
+            self._m_mesh_round = reg.counter(f"nr.exec.mesh.{tier}")
+            self._m_mesh_sync_bytes = reg.counter("mesh.sync_bytes")
+            self._m_mesh_dur = reg.histogram("mesh.round.duration_s")
+            self._m_ring = reg.counter("nr.exec.engine.ring")
+            announce_placement(mesh, n_replicas, "NodeReplicated", tier)
+            self._place_on_mesh()
         self._build_jits()
 
+    @_locked
+    def _place_on_mesh(self) -> None:
+        """(Re)apply the canonical mesh shardings to log + states —
+        after construction and after every fleet-shape change
+        (`grow_fleet`, `recover`, `restore`) whose fresh arrays would
+        otherwise land on the default device. No-op un-meshed."""
+        if self.mesh is None:
+            return
+        from node_replication_tpu.parallel.mesh import place
+
+        self.log, self.states = place(self.log, self.states, self.mesh)
+
+    def replica_device(self, rid: int):
+        """The device hosting replica `rid`'s state shard (None when
+        un-meshed) — the serve layer's worker-per-replica→device map.
+        NamedSharding(P('replica')) splits the replica axis into
+        contiguous blocks in mesh device order."""
+        if self.mesh is None:
+            return None
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        shard = rid // (self.n_replicas // self._mesh_shards)
+        return self.mesh.devices.reshape(self._mesh_shards, -1)[shard][0]
+
+    @_locked
+    def _shmap_fn(self, window: int, fenced: bool):
+        """Build-once cache of the explicit-collective exec programs
+        (`parallel/collectives.py:make_shmap_exec`), keyed (window,
+        fenced) like jit's own static cache."""
+        fn = self._shmap_cache.get((window, fenced))
+        if fn is None:
+            from node_replication_tpu.parallel.collectives import (
+                make_shmap_exec,
+            )
+
+            fn = make_shmap_exec(self.dispatch, self.spec, self.mesh,
+                                 window, fenced=fenced)
+            self._shmap_cache[(window, fenced)] = fn
+        return fn
+
+    def _shmap_exec_entry(self, log, states, window):
+        return self._shmap_fn(window, False)(log, states)
+
+    def _shmap_exec_fenced_entry(self, log, states, fenced, window):
+        return self._shmap_fn(window, True)(log, states, fenced)
+
+    @_locked
     def _build_jits(self) -> None:
         """(Re)build the compiled append/exec/read entry points against the
         CURRENT `self.spec` — called from `__init__` and `grow_fleet`
         (growing changes `n_replicas`, so the partials must rebind)."""
+        # mesh program caches are spec-bound too
+        self._shmap_cache: dict = {}
+        self._ring_fn = None
+        self._ring_gather = None
         dispatch = self.dispatch
         exec_fn = (
             partial(log_catchup_all, union=self._union)
@@ -373,6 +494,13 @@ class NodeReplicated:
             self._append_jit = jax.jit(
                 partial(log_append, self.spec), donate_argnums=(0,)
             )
+
+        if self.mesh is not None and self._mesh_tier == "shmap":
+            # the explicit-collective tier REPLACES the exec programs
+            # (append + read jits stay: appends are replicated writes,
+            # reads a one-replica gather — GSPMD handles both)
+            self._exec_jit = self._shmap_exec_entry
+            self._exec_fenced_jit = self._shmap_exec_fenced_entry
 
         def _read_one(states, rid, opcode, args):
             state = jax.tree.map(lambda a: a[rid], states)
@@ -437,6 +565,14 @@ class NodeReplicated:
         if k < 1:
             raise ValueError("grow_fleet needs k >= 1")
         R = self.n_replicas
+        if self.mesh is not None and (R + k) % self._mesh_shards:
+            # validated BEFORE any state mutates: an indivisible fleet
+            # cannot keep the P('replica') placement balanced
+            raise ValueError(
+                f"grown fleet of {R + k} replicas cannot shard over "
+                f"{self._mesh_shards} mesh shards (grow in multiples "
+                f"of the shard count)"
+            )
         ltails = np.asarray(self.log.ltails)
         if donor is None:
             # never clone from a fenced (possibly corrupt) replica
@@ -474,6 +610,7 @@ class NodeReplicated:
             self._fenced = np.concatenate(
                 [self._fenced, np.zeros(k, bool)]
             )
+        self._place_on_mesh()
         self._build_jits()
         new_rids = list(range(R, R + k))
         get_tracer().emit(
@@ -901,7 +1038,14 @@ class NodeReplicated:
         """Catch replicas up with the log tail (`Replica::sync`,
         `nr/src/replica.rs:469-479`); `rid=None` syncs all UNFENCED
         replicas (a fenced replica's replay is frozen — waiting on it
-        would never terminate; syncing it explicitly fails fast)."""
+        would never terminate; syncing it explicitly fails fast).
+
+        On a mesh, a large uniform backlog takes the RING tier first
+        (`_ring_catchup` — `parallel/collectives.py:make_ring_exec`):
+        the pending window shards over the chips and chunks rotate the
+        ICI ring while replica shards stay resident, so catch-up
+        bandwidth scales with the mesh instead of one chip's replay
+        rate. Falls back to ordinary exec rounds for the remainder."""
         if rid is not None and self._is_fenced(rid):
             raise ReplicaFencedError(rid)
         rounds = 0
@@ -918,8 +1062,90 @@ class NodeReplicated:
                 done = int(ltails[rid]) >= tail
             if done:
                 return
+            if self._ring_catchup():
+                continue  # made >= shard-count positions of progress
             self._exec_round()
             rounds = self._watchdog(rounds, "sync")
+
+    @_locked
+    def _ring_catchup(self) -> bool:
+        """One ring-replay pass over the pending window — the mesh
+        catch-up tier (`nr.exec.engine.ring` counter). Eligible only
+        when it is provably equivalent to the scan rounds it replaces:
+        a mesh is placed, no replica is fenced (the ring applies the
+        window to EVERY shard), no in-flight responses are owed (the
+        ring produces none — the reference's catch-up likewise applies
+        other replicas' entries without delivering their responses),
+        and every cursor sits at the same position (one shared window).
+        Applies `chunk * shards` entries in log order to all replicas
+        (bit-identical to the scan by the ring-schedule contract,
+        tests/test_collectives.py) and joins the cursor lattice
+        host-side. Returns False when ineligible; progress when True
+        is >= 2*shards positions, so callers cannot livelock on it."""
+        if self.mesh is None or self._mesh_tier == "gspmd":
+            return False
+        if self._fenced is not None or any(self._inflight):
+            return False
+        cur = np.asarray(
+            jnp.concatenate([self.log.ltails, self.log.tail[None]])
+        ).copy()
+        lts, tail = cur[:-1], int(cur[-1])
+        lt = int(lts.min())
+        if int(lts.max()) != lt:
+            return False
+        shards = self._mesh_shards
+        pending = tail - lt
+        if shards < 2 or pending < 2 * shards:
+            return False
+        # power-of-two per-chip chunk bounded by exec_window: bounds
+        # the per-window jit specializations (one per distinct W,
+        # keyed by the static `window` argument) to log2 widths
+        chunk = min(self.exec_window,
+                    1 << ((pending // shards).bit_length() - 1))
+        W = chunk * shards
+        if self._ring_gather is None:
+            self._ring_gather = jax.jit(
+                partial(gather_window, self.spec),
+                static_argnames=("window",),
+            )
+        opc, args = self._ring_gather(self.log.opcodes, self.log.args,
+                                      jnp.int64(lt), jnp.int64(tail),
+                                      window=W)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sh = NamedSharding(self.mesh, PartitionSpec("replica"))
+        opc = jax.device_put(opc, sh)
+        args = jax.device_put(args, sh)
+        if self._ring_fn is None:
+            from node_replication_tpu.parallel.collectives import (
+                make_ring_exec,
+            )
+
+            self._ring_fn = make_ring_exec(self.dispatch, self.mesh)
+        with span("ring-exec", window=W, chunk=chunk,
+                  shards=shards, start=lt) as sp:
+            self.states = self._ring_fn(opc, args, self.states)
+            sp.fence(self.states)
+        # cursor-lattice join, host-side: every replica consumed
+        # [lt, lt+W) in order, so ltails/ctail/head land at lt+W
+        # (head = min(ltails); no fenced mask here by eligibility)
+        new_lt = lt + W
+        self.log = self.log._replace(
+            ltails=jax.device_put(
+                np.full(self.n_replicas, new_lt, np.int64), sh
+            ),
+            ctail=jnp.maximum(self.log.ctail, jnp.int64(new_lt)),
+            head=jnp.int64(new_lt),
+        )
+        if self._wal is not None:
+            self._wal.maybe_reclaim(new_lt)
+        self._ring_rounds += 1
+        self._m_ring.inc()
+        # rotated-window ICI traffic: each chip forwards its chunk
+        # around the ring (2*shards - 1 hops of W/shards entries ≈ 2x
+        # the window) — counted once per pass, documented estimate
+        self._m_mesh_sync_bytes.inc(2 * (opc.nbytes + args.nbytes))
+        return True
 
     @_locked
     def checkpoint(self, path: str) -> None:
@@ -946,6 +1172,7 @@ class NodeReplicated:
                  log_entries=spec.capacity, gc_slack=spec.gc_slack,
                  **kwargs)
         _, nr.log, nr.states = load_snapshot(path, nr.states)
+        nr._place_on_mesh()  # loaded arrays land on the default device
         return nr
 
     @_locked
@@ -964,6 +1191,7 @@ class NodeReplicated:
             base_states=base_states, base_pos=base_pos,
             window=self.exec_window,
         )
+        self._place_on_mesh()  # rebuilt states: restore the shardings
         self._inflight = [deque() for _ in range(self.n_replicas)]
         # full-fleet rebuild: every replica is freshly consistent, so
         # any quarantine fencing is moot
@@ -984,7 +1212,9 @@ class NodeReplicated:
             "min_ltail": int(ltails.min()),
             "exec_rounds": self._exec_rounds,
             "idle_rounds": self._idle_rounds,
+            "ring_rounds": self._ring_rounds,
             "engine": self.engine,
+            "mesh_devices": self._mesh_shards,
             "max_lag": tail - int(ltails.min()),
         }
 
@@ -1024,7 +1254,19 @@ class NodeReplicated:
                 "window": self.exec_window,
                 "rounds": self._exec_rounds,
                 "idle_rounds": self._idle_rounds,
+                "ring_rounds": self._ring_rounds,
             },
+            "mesh": (
+                # shard shape only: a per-rid device dict would be
+                # O(R) reshapes + strings per snapshot poll at fleet
+                # scale (R=4096) — per-rid lookup is replica_device()
+                None if self.mesh is None else {
+                    "devices": self._mesh_shards,
+                    "tier": self._mesh_tier,
+                    "replicas_per_device":
+                        self.n_replicas // self._mesh_shards,
+                }
+            ),
             "metrics": get_registry().snapshot(),
         }
 
@@ -1106,8 +1348,13 @@ class NodeReplicated:
         self._m_engine.inc()
         tracer = get_tracer()
         # manual span: the hot path pays one branch when tracing is off
-        # (no context-manager frame, no clock read)
-        t0 = time.perf_counter() if tracer.enabled else 0.0
+        # (no context-manager frame, no clock read); mesh rounds always
+        # time — the collective-time histogram is part of the mesh.*
+        # observability contract
+        t0 = (
+            time.perf_counter()
+            if (tracer.enabled or self.mesh is not None) else 0.0
+        )
         f_arr = None if fenced is None else jnp.asarray(fenced)
         if self.debug:
             from node_replication_tpu.utils.checks import debug_checks
@@ -1152,6 +1399,18 @@ class NodeReplicated:
                     [int(resps_np[r, pos - int(ltails_before[r])])]
                 )
         progressed = bool(np.any(ltails_after > ltails_before))
+        sync_bytes = 0
+        if self.mesh is not None:
+            # mesh.* observability: rounds by tier, collective/round
+            # time, and the cross-device bytes this round FORCED back
+            # to the host (response matrix + the two cursor readbacks —
+            # the measurable gather traffic; the on-ICI lattice
+            # reductions are a few scalars on top)
+            sync_bytes = resps_np.nbytes + cur.nbytes + \
+                ltails_after.nbytes
+            self._m_mesh_round.inc()
+            self._m_mesh_dur.observe(time.perf_counter() - t0)
+            self._m_mesh_sync_bytes.inc(sync_bytes)
         if tracer.enabled:
             if tracer.fence_spans:
                 # device-honest end: block_until_ready returns at
@@ -1159,6 +1418,12 @@ class NodeReplicated:
                 from node_replication_tpu.utils.fence import fence
 
                 fence(self.log, self.states)
+            extra = (
+                {"mesh_tier": self._mesh_tier,
+                 "mesh_devices": self._mesh_shards,
+                 "sync_bytes": sync_bytes}
+                if self.mesh is not None else {}
+            )
             tracer.emit(
                 "exec-round",
                 duration_s=time.perf_counter() - t0,
@@ -1167,6 +1432,7 @@ class NodeReplicated:
                 window=self.exec_window,
                 progressed=progressed,
                 advanced=int((ltails_after - ltails_before).sum()),
+                **extra,
             )
         return progressed
 

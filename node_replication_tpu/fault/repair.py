@@ -26,6 +26,15 @@ manager suspects -> quarantines (fencing the replica out of GC) ->
 repairs on a dedicated medic thread -> readmits by restarting the
 replica's worker (`restart_replica`). `probe()` runs the divergence
 vote for silent corruption the exception path cannot see.
+
+Mesh fleets: the whole sequence is placement-agnostic. Fencing on a
+`NodeReplicated(mesh=...)` fleet keeps the GC-head mask correct when
+the corpse lives on a different chip than the combiner — the shmap
+exec tier reduces `head = min(unfenced ltails)` over ICI with the
+fenced shard masked out (`parallel/collectives.py:make_shmap_exec`),
+the gspmd tier runs the same `_gc_head` reduction GSPMD-sharded — and
+`clone_replica_from` is a cross-device donor copy under the canonical
+sharding. Pinned in tests/test_mesh_fleet.py's fenced differential.
 """
 
 from __future__ import annotations

@@ -61,6 +61,20 @@ CHAOS_CSV = "chaos_benchmarks.csv"
 RECOVERY_CSV = "recovery_benchmarks.csv"
 REPLICATION_CSV = "replication_benchmarks.csv"
 OVERLOAD_CSV = "overload_benchmarks.csv"
+MESH_CSV = "mesh_benchmarks.csv"
+# One row per (device count) point of a mesh scaling curve
+# (`bench.py --mesh`): replayed-dispatch throughput at that width,
+# `scaling_x` = throughput / the curve's 1-device throughput, and
+# `efficiency` = scaling_x / devices (1.0 = perfectly linear).
+# `bit_identical` is the curve's hard gate: the sharded fleet's states
+# after the verification steps equal the un-sharded fleet's
+# bit-for-bit (blank-or-1 rows are gate-worthy; 0 means the curve is
+# measuring a DIFFERENT computation and the bench exits nonzero).
+_MESH_FIELDS = [
+    "name", "devices", "replicas", "batch", "keys", "duration",
+    "throughput_mdps", "scaling_x", "efficiency", "bit_identical",
+    "spread_pct",
+]
 # One row per overload run (`bench.py --overload`), static baseline
 # and adaptive controller side by side: open-loop Poisson arrivals at
 # `rate` (a multiple of the measured closed-loop `capacity_ops`) with
@@ -866,6 +880,134 @@ def serve_rows(name: str, res: ServeResult) -> list[dict]:
 
 def append_serve_csv(out_dir: str, rows: list[dict]) -> None:
     _append_csv(os.path.join(out_dir, SERVE_CSV), _SERVE_FIELDS, rows)
+
+
+@dataclasses.dataclass
+class MeshPoint:
+    """One device-count point of a mesh scaling curve
+    (`bench.py --mesh`)."""
+
+    devices: int
+    result: MeasureResult
+    bit_identical: bool
+    spread_pct: float = 0.0
+
+
+def measure_mesh(
+    dispatch_factory: Callable,
+    device_counts: Sequence[int],
+    n_replicas: int,
+    writes_per_replica: int = 1,
+    reads_per_replica: int = 1,
+    keyspace: int = 1024,
+    duration_s: float = 1.0,
+    verify_steps: int = 4,
+    seed: int = 0,
+    wr_opcode: int = 1,
+    rd_opcode: int = 1,
+    repeats: int = 2,
+) -> list[MeshPoint]:
+    """Measure the 1→N-device scaling curve of the replica-sharded
+    fused step (`ShardedRunner` over `parallel/mesh.py`), with the
+    bit-identity gate the curve's honesty depends on: before each
+    point is timed, the sharded fleet replays `verify_steps` fixed
+    steps and its states must equal the 1-device reference fleet's
+    bit-for-bit — placement must never change results, only their
+    speed (the mesh acceptance contract, tests/test_mesh_fleet.py).
+
+    `device_counts` entries must divide `n_replicas`; entry 1 runs the
+    plain un-sharded runner (the flagship configuration). Each point
+    is measured `repeats` times; the reported result is the MEDIAN
+    repeat and `spread_pct` is the min→max spread across them (the
+    flagship bench's contention annotation — a shared chip can hand a
+    window a misleading slot). Returns one `MeshPoint` per count, in
+    order; `mesh_rows` turns them into `mesh_benchmarks.csv` rows with
+    scaling/efficiency relative to the first point.
+    """
+    spec = WorkloadSpec(keyspace=keyspace, write_ratio=50, seed=seed)
+    S = 8
+    streams = generate_batches(
+        spec, S, n_replicas, writes_per_replica, reads_per_replica,
+        wr_opcode=wr_opcode, rd_opcode=rd_opcode,
+    )
+
+    # 1-device reference states after the verification steps — every
+    # sharded point must reproduce these bit-for-bit
+    import jax
+
+    ref = ReplicatedRunner(dispatch_factory(), n_replicas,
+                           writes_per_replica, reads_per_replica)
+    ref.prepare(*streams)
+    for s in range(verify_steps):
+        ref.run_step(s % S)
+    ref.block()
+    ref_leaves = [np.asarray(a) for a in jax.tree.leaves(ref.states)]
+
+    points: list[MeshPoint] = []
+    for n_dev in device_counts:
+        if n_dev == 1:
+            runner = ReplicatedRunner(
+                dispatch_factory(), n_replicas, writes_per_replica,
+                reads_per_replica,
+            )
+        else:
+            runner = ShardedRunner(
+                dispatch_factory(), n_replicas, writes_per_replica,
+                reads_per_replica, n_devices=n_dev,
+            )
+        runner.prepare(*streams)
+        for s in range(verify_steps):
+            runner.run_step(s % S)
+        runner.block()
+        got = [np.asarray(a) for a in jax.tree.leaves(runner.states)]
+        bit_identical = all(
+            np.array_equal(a, b) for a, b in zip(ref_leaves, got)
+        )
+        results = [
+            measure_step_runner(runner, *streams,
+                                duration_s=duration_s)
+            for _ in range(max(1, repeats))
+        ]
+        results.sort(key=lambda r: r.mops)
+        res = results[len(results) // 2]  # median repeat
+        spread = (
+            100.0 * (results[-1].mops - results[0].mops) / res.mops
+            if res.mops else 0.0
+        )
+        points.append(MeshPoint(devices=int(n_dev), result=res,
+                                bit_identical=bit_identical,
+                                spread_pct=spread))
+    return points
+
+
+def mesh_rows(name: str, points: list[MeshPoint], batch: int,
+              keys: int, replicas: int | str = "") -> list[dict]:
+    """MESH_CSV rows: throughput + scaling efficiency vs the curve's
+    first (narrowest) point."""
+    if not points:
+        return []
+    base = points[0].result.mops or 1e-9
+    rows = []
+    for p in points:
+        scaling = p.result.mops / base
+        rows.append({
+            "name": f"{name}/mesh{p.devices}",
+            "devices": p.devices,
+            "replicas": replicas,
+            "batch": batch,
+            "keys": keys,
+            "duration": round(p.result.duration_s, 3),
+            "throughput_mdps": round(p.result.mops, 3),
+            "scaling_x": round(scaling, 4),
+            "efficiency": round(scaling / p.devices, 4),
+            "bit_identical": int(p.bit_identical),
+            "spread_pct": round(p.spread_pct, 2),
+        })
+    return rows
+
+
+def append_mesh_csv(out_dir: str, rows: list[dict]) -> None:
+    _append_csv(os.path.join(out_dir, MESH_CSV), _MESH_FIELDS, rows)
 
 
 @dataclasses.dataclass

@@ -371,6 +371,41 @@ def analyze(events: list[dict]) -> dict:
             "promotions": promotions,
         }
 
+    # mesh section: placement, rounds by collective tier, collective
+    # time, cross-device sync bytes, ring catch-up passes (parallel/)
+    mesh = None
+    places = [e for e in events if e.get("event") == "mesh-place"]
+    mesh_rounds = [e for e in events
+                   if e.get("event") == "exec-round"
+                   and e.get("mesh_tier")]
+    rings = [e for e in events if e.get("event") == "ring-exec"]
+    if places or mesh_rounds or rings:
+        by_tier: dict[str, int] = defaultdict(int)
+        durs = []
+        sync_bytes = 0
+        for e in mesh_rounds:
+            by_tier[str(e["mesh_tier"])] += 1
+            durs.append(float(e.get("duration_s", 0.0)))
+            sync_bytes += int(e.get("sync_bytes", 0))
+        durs.sort()
+        mesh = {
+            "placements": [
+                {"wrapper": e.get("wrapper", "?"),
+                 "devices": int(e.get("devices", 0)),
+                 "replicas": int(e.get("replicas", 0)),
+                 "per_device": int(e.get("per_device", 0)),
+                 "tier": e.get("tier", "?")}
+                for e in places
+            ],
+            "rounds_by_tier": dict(sorted(by_tier.items())),
+            "collective_time_s": sum(durs),
+            "round_p50_s": _percentile(durs, 0.50),
+            "round_p95_s": _percentile(durs, 0.95),
+            "sync_bytes": sync_bytes,
+            "ring_execs": len(rings),
+            "ring_ops": sum(int(e.get("window", 0)) for e in rings),
+        }
+
     return {
         "n_events": len(events),
         "event_counts": dict(counts),
@@ -383,6 +418,7 @@ def analyze(events: list[dict]) -> dict:
         "fault": fault,
         "durability": durability,
         "replication": repl,
+        "mesh": mesh,
         "stalls": [
             {"where": where, "log": log, **{k: (sorted(v)
                                                if isinstance(v, set)
@@ -576,6 +612,25 @@ def render(report: dict, out=None) -> None:
               f"({p['drained_records']} drained); detect "
               f"{_fmt_s(p['detect_s'])} + promote "
               f"{_fmt_s(p['promote_s'])} = RTO {_fmt_s(p['rto_s'])}\n")
+
+    mesh = report.get("mesh")
+    if mesh:
+        w("\n== mesh ==\n")
+        for pl in mesh["placements"]:
+            w(f"  {pl['wrapper']}: {pl['replicas']} replica(s) over "
+              f"{pl['devices']} device(s) "
+              f"({pl['per_device']}/device), tier {pl['tier']}\n")
+        tiers = mesh["rounds_by_tier"]
+        if tiers:
+            w("  rounds by tier: "
+              + "   ".join(f"{k}={v}" for k, v in sorted(tiers.items()))
+              + f"   collective time {_fmt_s(mesh['collective_time_s'])}"
+                f" (p50 {_fmt_s(mesh['round_p50_s'])} "
+                f"p95 {_fmt_s(mesh['round_p95_s'])})\n")
+        w(f"  cross-device sync: {mesh['sync_bytes']} byte(s)\n")
+        if mesh["ring_execs"]:
+            w(f"  ring catch-up: {mesh['ring_execs']} pass(es), "
+              f"{mesh['ring_ops']} op(s) rotated over ICI\n")
 
     w("\n== stall report ==\n")
     if not report["stalls"]:

@@ -1,7 +1,13 @@
+from node_replication_tpu.parallel.collectives import (
+    make_ring_exec,
+    make_shmap_exec,
+    make_shmap_step,
+)
 from node_replication_tpu.parallel.mesh import (
     ReplicaStrategy,
     make_mesh,
     place,
+    replica_mesh,
     shard_step,
 )
 from node_replication_tpu.parallel.topology import MachineTopology
@@ -9,7 +15,11 @@ from node_replication_tpu.parallel.topology import MachineTopology
 __all__ = [
     "ReplicaStrategy",
     "make_mesh",
+    "make_ring_exec",
+    "make_shmap_exec",
+    "make_shmap_step",
     "place",
+    "replica_mesh",
     "shard_step",
     "MachineTopology",
 ]

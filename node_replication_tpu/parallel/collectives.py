@@ -1,7 +1,18 @@
 """Explicit-collective multi-chip replay: shard_map + ICI primitives.
 
+PRODUCTION STATUS: this module is no longer a dryrun demo. Since the
+mesh-sharded-fleet work, `make_shmap_exec` IS `NodeReplicated`'s exec
+round on a mesh (`NodeReplicated(mesh=..., collectives="shmap")` — the
+default tier for scan-engine models), and `make_ring_exec` backs the
+ring catch-up tier `NodeReplicated.sync()` takes for large uniform
+backlogs. `make_shmap_step` remains the fused lock-step batch path
+(`ShardedRunner`'s explicit twin and `__graft_entry__.dryrun_multichip`'s
+convergence probe). Per-tier selection counters live next to the other
+engine tiers (`log.engine.shmap`, `nr.exec.engine.ring`,
+`nr.exec.mesh.*` — core/log.py, core/replica.py).
+
 `parallel/mesh.py` scales by annotation (GSPMD inserts the collectives);
-this module is the hand-scheduled path for the two places where owning the
+this module is the hand-scheduled path for the places where owning the
 communication pattern matters (SURVEY.md §2.6 "TPU-native equivalent"):
 
 1. `make_shmap_step` — the fused append→replay→read step as a `shard_map`
@@ -39,7 +50,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from node_replication_tpu.core.log import LogSpec, LogState, _exec_one
+from node_replication_tpu.core.log import (
+    LogSpec,
+    LogState,
+    _FAR,
+    _exec_one,
+    _m_engine_shmap,
+)
 from node_replication_tpu.utils.compat import shard_map
 from node_replication_tpu.ops.encoding import (
     Dispatch,
@@ -126,6 +143,98 @@ def make_shmap_step(
         check_vma=False,
     )
     return jax.jit(fn)
+
+
+def make_shmap_exec(
+    dispatch: Dispatch,
+    spec: LogSpec,
+    mesh: Mesh,
+    window: int,
+    axis: str = "replica",
+    fenced: bool = False,
+    donate: bool = True,
+):
+    """Explicit-collective twin of `core/log.py:log_exec_all` — the
+    catch-up/exec-round half of `make_shmap_step`, promoted into
+    `NodeReplicated._exec_round` for mesh-sharded fleets.
+
+    Unlike the fused step, cursors may DIVERGE: each chip replays its
+    replica shard from that shard's own `ltails` (the vmapped
+    `_exec_one` scan — bit-identical to every engine by the
+    differential contracts), and the cursor lattice is joined over ICI:
+    `ctail = max(ctail, pmax(max local ltails))` (fetch_max,
+    `nr/src/log.rs:520-523`) and `head = pmin(min local ltails)`
+    (`advance_head` GC, `nr/src/log.rs:536-580`). The log's ring
+    arrays are replicated, so replay reads are chip-local; the only
+    cross-chip traffic is the two scalar lattice reductions.
+
+    `fenced=True` builds the quarantine-mask variant
+    (`fault/health.py`): the returned fn takes an extra bool[R] mask
+    sharded over `axis`; fenced replicas are frozen at their ltail
+    (limits) and excluded from the GC-head reduction — the masked min
+    uses the `_FAR` sentinel, and an all-fenced fleet holds `head`
+    still — exactly `core/log.py:_freeze_limits`/`_gc_head` with the
+    min taken over ICI instead of one device. This keeps the
+    fenced-head GC mask correct when the fenced replica lives on a
+    different chip than the combiner.
+
+    Returns a jitted `exec(log, states[, fenced]) -> (log, states,
+    resps)` with the `log_exec_all` response-layout contract:
+    `resps[r, i]` answers logical position `old_ltails[r] + i`.
+    Requires `R % mesh.shape[axis] == 0`.
+    """
+    R = spec.n_replicas
+    nshards = mesh.shape[axis]
+    if R % nshards:
+        raise ValueError(f"R={R} not divisible by {nshards} shards")
+    # nrlint: disable=obs-in-traced — per-build tier counter by design
+    _m_engine_shmap.inc()
+
+    def local(log, states_l, *mask):
+        lt_l = log.ltails  # the LOCAL [R/nshards] cursor shard
+        if fenced:
+            fenced_l = mask[0]
+            # _freeze_limits, shard-local: a fenced replica is frozen
+            # at its own ltail; others replay to the tail
+            limits_l = jnp.where(fenced_l, lt_l, jnp.int64(_FAR))
+            states_l, resps_l, new_lt = jax.vmap(
+                lambda s, lt, lim: _exec_one(
+                    spec, dispatch, log, s, lt, window, lim
+                )
+            )(states_l, lt_l, limits_l)
+            # _gc_head over ICI: min over unfenced cursors fleet-wide;
+            # all-fenced holds head still (pmin of all-_FAR detects it)
+            masked = jnp.where(fenced_l, jnp.int64(_FAR), new_lt)
+            gmin = lax.pmin(jnp.min(masked), axis)
+            head = jnp.where(
+                gmin >= jnp.int64(_FAR), log.head,
+                jnp.maximum(log.head, gmin),
+            )
+        else:
+            states_l, resps_l, new_lt = jax.vmap(
+                lambda s, lt: _exec_one(spec, dispatch, log, s, lt,
+                                        window)
+            )(states_l, lt_l)
+            head = lax.pmin(jnp.min(new_lt), axis)
+        ctail = jnp.maximum(
+            log.ctail, lax.pmax(jnp.max(new_lt), axis)
+        )
+        log = log._replace(ltails=new_lt, ctail=ctail, head=head)
+        return log, states_l, resps_l
+
+    shardy = P(axis)
+    log_specs = LogState(opcodes=P(), args=P(), head=P(), tail=P(),
+                         ctail=P(), ltails=shardy)
+    state_specs = jax.tree.map(lambda _: shardy, dispatch.init_state())
+    in_specs = (log_specs, state_specs) + ((shardy,) if fenced else ())
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(log_specs, state_specs, shardy),
+        check_vma=False,
+    )
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
 
 
 def make_ring_exec(

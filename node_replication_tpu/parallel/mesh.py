@@ -20,6 +20,13 @@ No hand-written collectives: shardings are declared with
 `jax.sharding.NamedSharding` on a jitted pure step and GSPMD inserts the
 all-gathers (scaling-book recipe: pick a mesh, annotate, let XLA place
 collectives).
+
+PRODUCTION STATUS: this module is the placement layer of the stateful
+wrappers, not a demo — `NodeReplicated(mesh=...)` and
+`MultiLogReplicated(mesh=...)` call `place()` at construction (and
+after every fleet-shape change) so their replica axis lives across the
+mesh, and `replica_mesh()` is the one-liner most callers want. The
+explicit-collective twin lives in `parallel/collectives.py`.
 """
 
 from __future__ import annotations
@@ -96,7 +103,25 @@ def make_mesh(
     return Mesh(arr, ("replica", "log"))
 
 
-def _log_spec_tree(log, mesh: Mesh):
+def replica_mesh(n_shards: int | None = None, devices=None,
+                 strategy: "ReplicaStrategy | None" = None,
+                 mapping=None) -> Mesh:
+    """One-axis ('replica', 'log'=1) mesh for a replica-sharded fleet —
+    the `NodeReplicated(mesh=...)` convenience. `n_shards=None` takes
+    every device; a `ReplicaStrategy` picks the device set through the
+    topology walk (`strategy_devices`)."""
+    if strategy is not None:
+        devices = strategy_devices(strategy, mapping=mapping)
+        if n_shards is not None:
+            devices = devices[:n_shards]
+    elif devices is None:
+        devices = jax.devices()
+        if n_shards is not None:
+            devices = list(devices)[:n_shards]
+    return make_mesh(len(list(devices)), 1, devices=devices)
+
+
+def log_spec_tree(log, mesh: Mesh):
     """Sharding pytree for a log state. Single-log: fully replicated
     (identical append on every chip). Multi-log: ring + cursors shard over
     the 'log' mesh axis on their leading log dimension."""
@@ -120,16 +145,41 @@ def _log_spec_tree(log, mesh: Mesh):
     )
 
 
-def _states_spec_tree(states, mesh: Mesh):
+def states_spec_tree(states, mesh: Mesh):
     """Replica states shard on the leading (replica) axis."""
     return jax.tree.map(lambda _: NamedSharding(mesh, P("replica")), states)
 
 
+# compat aliases (pre-production private names)
+_log_spec_tree = log_spec_tree
+_states_spec_tree = states_spec_tree
+
+
 def place(log, states, mesh: Mesh):
     """device_put log + states with their canonical shardings."""
-    log = jax.device_put(log, _log_spec_tree(log, mesh))
-    states = jax.device_put(states, _states_spec_tree(states, mesh))
+    log = jax.device_put(log, log_spec_tree(log, mesh))
+    states = jax.device_put(states, states_spec_tree(states, mesh))
     return log, states
+
+
+def announce_placement(mesh: Mesh, n_replicas: int, wrapper: str,
+                       tier: str) -> None:
+    """Record a wrapper's mesh placement in obs: `mesh.*` gauges
+    (per-device replica count, device count) and one `mesh-place`
+    trace event — the report CLI's Mesh section feeds on these."""
+    from node_replication_tpu.obs.metrics import get_registry
+    from node_replication_tpu.utils.trace import get_tracer
+
+    n_shards = int(np.prod(mesh.devices.shape))
+    per_device = n_replicas // max(1, mesh.shape.get("replica", 1))
+    reg = get_registry()
+    reg.gauge("mesh.devices").set(n_shards)
+    reg.gauge("mesh.replicas_per_device").set(per_device)
+    get_tracer().emit(
+        "mesh-place", wrapper=wrapper, devices=n_shards,
+        replicas=n_replicas, per_device=per_device, tier=tier,
+        shape=dict(mesh.shape),
+    )
 
 
 def shard_step(step_fn, mesh: Mesh, log_template, states_template,
@@ -142,8 +192,8 @@ def shard_step(step_fn, mesh: Mesh, log_template, states_template,
     """
     if batch_spec is None:
         batch_spec = P("replica")
-    log_s = _log_spec_tree(log_template, mesh)
-    states_s = _states_spec_tree(states_template, mesh)
+    log_s = log_spec_tree(log_template, mesh)
+    states_s = states_spec_tree(states_template, mesh)
     bs = NamedSharding(mesh, batch_spec)
     return jax.jit(
         step_fn,

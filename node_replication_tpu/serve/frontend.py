@@ -53,6 +53,14 @@ Production edges, each with a typed signal (`serve/errors.py`):
   mid-traffic state after a crash: newest valid snapshot + WAL-tail
   replay, bit-identical, WAL re-attached, serving resumed.
 
+- **mesh fleets** (`NodeReplicated(mesh=...)`, the `parallel/`
+  integration) — a fleet whose replica axis is sharded across the TPU
+  mesh serves through the same queues and workers: each combiner
+  worker's replica shard lives on one device (a fleet larger than any
+  single chip's HBM), the worker→device map is recorded at
+  construction (`stats()["mesh"]`, `device_of_rid`), and batch rounds
+  run the wrapper's cross-device collective tiers transparently.
+
 Reads bypass the write queue entirely: `read()` dispatches against the
 caller's replica through the wrapper's read-sync path (`execute`),
 which waits only for this replica to pass the completed tail — read
@@ -579,6 +587,15 @@ class ServeFrontend:
         self._m_batch_dur = reg.histogram("serve.batch.duration_s")
         self._m_req_lat = reg.histogram("serve.request.latency_s")
 
+        #: mesh fleet (`NodeReplicated(mesh=...)`): worker-per-replica
+        #: → device map. Each combiner worker owns a replica whose
+        #: state shard lives on ONE device of the mesh, so a fleet
+        #: bigger than any single chip's HBM serves through the same
+        #: queue/worker machinery — the map records which chip each
+        #: worker's rounds land on (stats()["mesh"], obs gauges via
+        #: announce_placement at wrapper construction).
+        self.device_of_rid: dict[int, str] = {}
+
         with self._lock:
             for rid in (rids if rids is not None
                         else range(nr.n_replicas)):
@@ -588,8 +605,13 @@ class ServeFrontend:
                 (self._queues[rid], self._workers[rid],
                  self._read_tokens[rid],
                  self._depth_gauges[rid]) = self._new_replica(rid)
+                self._record_device(rid)
         if auto_start:
             self.start()
+
+    def _record_device(self, rid: int) -> None:
+        if getattr(self._nr, "mesh", None) is not None:
+            self.device_of_rid[rid] = str(self._nr.replica_device(rid))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -688,6 +710,7 @@ class ServeFrontend:
                 (self._queues[rid], self._workers[rid],
                  self._read_tokens[rid],
                  self._depth_gauges[rid]) = self._new_replica(rid)
+                self._record_device(rid)
             started = self._started
         get_tracer().emit("serve-grow", rids=list(map(int, new_rids)))
         if started:
@@ -1094,6 +1117,15 @@ class ServeFrontend:
         agg["replicas"] = per
         if self.governor is not None:
             agg["overload"] = self.governor.stats()
+        if self.device_of_rid:
+            per_dev: dict[str, int] = {}
+            for dev in self.device_of_rid.values():
+                per_dev[dev] = per_dev.get(dev, 0) + 1
+            agg["mesh"] = {
+                "devices": len(per_dev),
+                "replicas_per_device": per_dev,
+                "device_of_rid": dict(sorted(self.device_of_rid.items())),
+            }
         return agg
 
     # ------------------------------------------------------------ worker

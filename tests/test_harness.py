@@ -317,3 +317,46 @@ class TestReplicaStrategy:
         with open(tmp_path / "scaleout_benchmarks.csv") as f:
             tms = {row["tm"] for row in csv.DictReader(f)}
         assert tms == {"one", "per_device"}
+
+
+class TestMeshCurve:
+    def test_measure_mesh_curve_and_csv(self, tmp_path):
+        # the bench.py --mesh engine: bit-identity verified per point,
+        # scaling/efficiency relative to the 1-device base, CSV schema
+        import csv
+        import os
+
+        import jax
+
+        from node_replication_tpu.harness.mkbench import (
+            MESH_CSV,
+            append_mesh_csv,
+            measure_mesh,
+            mesh_rows,
+        )
+        from node_replication_tpu.models import (
+            HM_GET,
+            HM_PUT,
+            make_hashmap,
+        )
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 virtual devices")
+        points = measure_mesh(
+            lambda: make_hashmap(64), [1, 2], 8,
+            writes_per_replica=2, reads_per_replica=2, keyspace=64,
+            duration_s=0.1, verify_steps=3, wr_opcode=HM_PUT,
+            rd_opcode=HM_GET,
+        )
+        assert [p.devices for p in points] == [1, 2]
+        assert all(p.bit_identical for p in points)
+        rows = mesh_rows("test", points, batch=4, keys=64, replicas=8)
+        assert rows[0]["scaling_x"] == 1.0
+        assert rows[0]["efficiency"] == 1.0
+        assert all(r["bit_identical"] == 1 for r in rows)
+        append_mesh_csv(str(tmp_path), rows)
+        with open(os.path.join(str(tmp_path), MESH_CSV)) as f:
+            got = list(csv.DictReader(f))
+        assert len(got) == 2
+        assert got[1]["devices"] == "2"
+        assert float(got[1]["throughput_mdps"]) > 0

@@ -1,0 +1,358 @@
+"""Mesh-sharded fleet differential suite (ISSUE 10).
+
+The acceptance contract of the mesh work: placement changes SPEED,
+never results. Every test drives the same op sequence through an
+un-meshed wrapper and a mesh-sharded twin (replica axis under
+`NamedSharding(mesh, P('replica'))`, 8 forced host devices — see
+conftest.py) and requires bit-identical responses, states, and cursor
+lattices — scan AND union engines, both collective tiers (shmap /
+gspmd), hashmap AND seqreg models, with a fenced-replica case pinning
+the cross-device GC-head mask and a ring-tier case pinning the
+collective catch-up path. This file is the CI `mesh-smoke` job.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from node_replication_tpu import NodeReplicated
+from node_replication_tpu.core.cnr import MultiLogReplicated
+from node_replication_tpu.core.log import log_append
+from node_replication_tpu.models import (
+    HM_GET,
+    HM_PUT,
+    SR_GET,
+    SR_SET,
+    make_hashmap,
+    make_seqreg,
+)
+from node_replication_tpu.obs.metrics import get_registry
+from node_replication_tpu.parallel import make_mesh, replica_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return replica_mesh(8)
+
+
+def _assert_fleets_equal(ref, got):
+    for a, b in zip(jax.tree.leaves(ref.states),
+                    jax.tree.leaves(got.states)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        np.asarray(ref.log.ltails), np.asarray(got.log.ltails)
+    )
+    for cursor in ("tail", "ctail", "head"):
+        assert int(getattr(ref.log, cursor)) == int(
+            getattr(got.log, cursor)
+        ), cursor
+
+
+def _seqreg_pair(mesh, **kw):
+    mk = lambda **extra: NodeReplicated(
+        make_seqreg(8), n_replicas=8, log_entries=1 << 12,
+        gc_slack=64, exec_window=32, **extra,
+    )
+    return mk(**kw), mk(mesh=mesh, **kw)
+
+
+def _hashmap_pair(mesh, **kw):
+    mk = lambda **extra: NodeReplicated(
+        make_hashmap(64), n_replicas=8, log_entries=1 << 12,
+        gc_slack=64, exec_window=32, **extra,
+    )
+    return mk(**kw), mk(mesh=mesh, **kw)
+
+
+class TestNodeReplicatedMesh:
+    def test_scan_engine_shmap_tier_bit_identical(self, mesh):
+        # seqreg has no combined form on purpose: the scan engine →
+        # the explicit-collective shard_map tier
+        ref, got = _seqreg_pair(mesh)
+        assert got.engine == "scan" and got._mesh_tier == "shmap"
+        t_ref, t_got = ref.register(2), got.register(2)
+        for i in range(60):
+            op = (SR_SET, i % 8, i)
+            assert ref.execute_mut(op, t_ref) == got.execute_mut(
+                op, t_got
+            )
+        assert ref.execute((SR_GET, 5), t_ref) == got.execute(
+            (SR_GET, 5), t_got
+        )
+        ref.sync()
+        got.sync()
+        _assert_fleets_equal(ref, got)
+
+    def test_union_engine_gspmd_tier_bit_identical(self, mesh):
+        # hashmap is window_canonical → combined engine → GSPMD tier
+        # (the union-plan economics survive sharding by annotation)
+        ref, got = _hashmap_pair(mesh)
+        assert got.engine == "combined" and got._mesh_tier == "gspmd"
+        t_ref, t_got = ref.register(0), got.register(0)
+        rng = np.random.default_rng(3)
+        for i in range(60):
+            op = (HM_PUT, int(rng.integers(64)),
+                  int(rng.integers(1000)), 0)
+            assert ref.execute_mut(op, t_ref) == got.execute_mut(
+                op, t_got
+            )
+        for k in (0, 7, 31):
+            assert ref.execute((HM_GET, k), t_ref) == got.execute(
+                (HM_GET, k), t_got
+            )
+        ref.sync()
+        got.sync()
+        _assert_fleets_equal(ref, got)
+
+    def test_shmap_forced_on_combined_model(self, mesh):
+        # collectives='shmap' on a combined-engine model: the scan
+        # collective replaces the union plan — still bit-identical
+        # (the engines are pinned equal), placement-only difference
+        ref = NodeReplicated(make_hashmap(64), n_replicas=8,
+                             log_entries=1 << 12, gc_slack=64,
+                             exec_window=32)
+        got = NodeReplicated(make_hashmap(64), n_replicas=8,
+                             log_entries=1 << 12, gc_slack=64,
+                             exec_window=32, mesh=mesh,
+                             collectives="shmap")
+        assert got._mesh_tier == "shmap"
+        t_ref, t_got = ref.register(0), got.register(0)
+        for i in range(40):
+            op = (HM_PUT, i % 64, i, 0)
+            assert ref.execute_mut(op, t_ref) == got.execute_mut(
+                op, t_got
+            )
+        ref.sync()
+        got.sync()
+        _assert_fleets_equal(ref, got)
+
+    def test_batch_path_bit_identical(self, mesh):
+        # the serve entry point (execute_mut_batch) over the mesh
+        ref, got = _seqreg_pair(mesh)
+        ops = [(SR_SET, i % 8, i) for i in range(96)]
+        assert ref.execute_mut_batch(ops, rid=1) == \
+            got.execute_mut_batch(ops, rid=1)
+        ref.sync()
+        got.sync()
+        _assert_fleets_equal(ref, got)
+
+    @pytest.mark.parametrize("pair", ["seqreg", "hashmap"])
+    def test_fenced_gc_mask_across_devices(self, mesh, pair):
+        # the fenced-head GC mask must stay correct when the corpse
+        # lives on a different device than the combiner: fence a
+        # replica mid-run on BOTH engines' tiers, require identical
+        # heads/ltails/states, then repair and require convergence
+        mk = _seqreg_pair if pair == "seqreg" else _hashmap_pair
+        ref, got = mk(mesh)
+        mkop = (
+            (lambda i: (SR_SET, i % 8, i)) if pair == "seqreg"
+            else (lambda i: (HM_PUT, i % 64, i, 0))
+        )
+        for nr in (ref, got):
+            t = nr.register(0)
+            for i in range(24):
+                nr.execute_mut(mkop(i), t)
+            nr.fence_replica(5)
+            for i in range(24, 48):
+                nr.execute_mut(mkop(i), t)
+        # the fenced cursor is frozen; head advanced past it
+        assert int(np.asarray(got.log.ltails)[5]) < int(got.log.head)
+        _assert_fleets_equal(ref, got)
+        for nr in (ref, got):
+            nr.clone_replica_from(5)
+            nr.unfence_replica(5)
+            nr.sync()
+            assert nr.replicas_equal()
+        _assert_fleets_equal(ref, got)
+
+    def test_ring_catchup_tier_bit_identical(self, mesh):
+        # a large uniform backlog takes the ring tier on the mesh
+        # (make_ring_exec promoted into sync()) — and must land on the
+        # same states/cursors as the un-meshed scan rounds
+        ref, got = _seqreg_pair(mesh)
+        rng = np.random.default_rng(0)
+        N = 400
+        opc = np.full(N, SR_SET, np.int32)
+        args = np.zeros((N, 3), np.int32)
+        args[:, 0] = rng.integers(0, 8, N)
+        args[:, 1] = rng.integers(0, 1000, N)
+        for nr in (ref, got):
+            nr.log = log_append(nr.spec, nr.log, jnp.asarray(opc),
+                                jnp.asarray(args), N)
+            nr.sync()
+        assert got._ring_rounds > 0, "ring tier never fired"
+        assert ref._ring_rounds == 0
+        _assert_fleets_equal(ref, got)
+
+    def test_ring_tier_counter(self, mesh):
+        reg = get_registry()
+        reg.enable()
+        try:
+            _, got = _seqreg_pair(mesh)
+            before = reg.counter("nr.exec.engine.ring").value
+            N = 200
+            opc = np.full(N, SR_SET, np.int32)
+            args = np.zeros((N, 3), np.int32)
+            got.log = log_append(got.spec, got.log, jnp.asarray(opc),
+                                 jnp.asarray(args), N)
+            got.sync()
+            assert reg.counter("nr.exec.engine.ring").value > before
+            assert reg.counter("nr.exec.mesh.shmap").value > 0
+            assert reg.counter("mesh.sync_bytes").value > 0
+            assert reg.gauge("mesh.replicas_per_device").value == 1
+        finally:
+            reg.disable()
+
+    def test_grow_fleet_keeps_placement(self, mesh):
+        ref, got = _seqreg_pair(mesh)
+        t_ref, t_got = ref.register(0), got.register(0)
+        for i in range(16):
+            op = (SR_SET, i % 8, i)
+            ref.execute_mut(op, t_ref)
+            got.execute_mut(op, t_got)
+        # growing by a non-multiple of the shard count is rejected
+        # BEFORE any state mutates
+        with pytest.raises(ValueError):
+            got.grow_fleet(3)
+        assert got.n_replicas == 8
+        ref.grow_fleet(8)
+        new = got.grow_fleet(8)
+        assert new == list(range(8, 16))
+        for i in range(16, 32):
+            op = (SR_SET, i % 8, i)
+            assert ref.execute_mut(op, t_ref) == got.execute_mut(
+                op, t_got
+            )
+        ref.sync()
+        got.sync()
+        _assert_fleets_equal(ref, got)
+        assert got.replicas_equal()
+
+    def test_checkpoint_restore_replaces(self, mesh, tmp_path):
+        _, got = _seqreg_pair(mesh)
+        t = got.register(0)
+        for i in range(20):
+            got.execute_mut((SR_SET, i % 8, i), t)
+        path = str(tmp_path / "snap.npz")
+        got.checkpoint(path)
+        back = NodeReplicated.restore(path, make_seqreg(8), mesh=mesh)
+        _assert_fleets_equal(got, back)
+        # the restored fleet still runs mesh rounds
+        t2 = back.register(0)
+        assert back.execute_mut((SR_SET, 0, 999), t2) is not None
+        assert back._mesh_tier is not None
+
+    def test_validation(self, mesh):
+        with pytest.raises(ValueError):  # 8 shards can't take R=6
+            NodeReplicated(make_seqreg(4), n_replicas=6, mesh=mesh)
+        with pytest.raises(ValueError):  # unknown tier
+            NodeReplicated(make_seqreg(4), n_replicas=8, mesh=mesh,
+                           collectives="nope")
+        with pytest.raises(ValueError):  # shmap has no checkify twin
+            NodeReplicated(make_seqreg(4), n_replicas=8, mesh=mesh,
+                           collectives="shmap", debug=True)
+
+    def test_replica_device_map(self, mesh):
+        _, got = _seqreg_pair(mesh)
+        devs = [str(got.replica_device(r)) for r in range(8)]
+        assert len(set(devs)) == 8  # 8 replicas over 8 devices
+        snap = got.snapshot()
+        assert snap["mesh"]["devices"] == 8
+        assert snap["mesh"]["replicas_per_device"] == 1
+        un = NodeReplicated(make_seqreg(4), n_replicas=2)
+        assert un.replica_device(0) is None
+        assert un.snapshot()["mesh"] is None
+
+    def test_serve_frontend_maps_workers_to_devices(self, mesh):
+        from node_replication_tpu.serve import ServeConfig, ServeFrontend
+
+        _, got = _seqreg_pair(mesh)
+        with ServeFrontend(got, ServeConfig(batch_max_ops=8,
+                                            batch_linger_s=0.0)) as fe:
+            for i in range(1, 9):
+                assert fe.call((SR_SET, 2, i),
+                               rid=i % got.n_replicas) == i - 1
+            st = fe.stats()
+        assert st["mesh"]["devices"] == 8
+        assert sum(st["mesh"]["replicas_per_device"].values()) == 8
+        assert len(st["mesh"]["device_of_rid"]) == 8
+
+
+class TestCnrMesh:
+    def _pair(self, mesh_shape=(2, 4)):
+        mesh = make_mesh(*mesh_shape)
+        mapper = lambda opc, args: args[0]
+        mk = lambda **extra: MultiLogReplicated(
+            make_hashmap(64), mapper, nlogs=4, n_replicas=2,
+            log_entries=1 << 10, gc_slack=32, exec_window=32, **extra,
+        )
+        return mk(), mk(mesh=mesh)
+
+    def test_cnr_bit_identical(self, mesh):
+        ref, got = self._pair()
+        rng = np.random.default_rng(5)
+        for nr in (ref, got):
+            t = nr.register(0)
+            r2 = nr.register(1)
+            rr = np.random.default_rng(5)
+            for i in range(60):
+                nr.execute_mut(
+                    (HM_PUT, int(rr.integers(64)),
+                     int(rr.integers(1000)), 0), t)
+            nr.sync()
+            assert nr.execute((HM_GET, 7), r2) is not None
+        for a, b in zip(jax.tree.leaves(ref.states),
+                        jax.tree.leaves(got.states)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for cur in ("tail", "ctail", "head"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref.ml, cur)),
+                np.asarray(getattr(got.ml, cur)),
+            )
+        np.testing.assert_array_equal(
+            np.asarray(ref.ml.ltails), np.asarray(got.ml.ltails)
+        )
+        assert got.snapshot()["mesh"]["shape"] == {
+            "replica": 2, "log": 4,
+        }
+
+    def test_cnr_batch_bit_identical(self, mesh):
+        ref, got = self._pair()
+        ops = [(HM_PUT, i % 64, i, 0) for i in range(48)]
+        assert ref.execute_mut_batch(ops, rid=0) == \
+            got.execute_mut_batch(ops, rid=0)
+        ref.sync()
+        got.sync()
+        for a, b in zip(jax.tree.leaves(ref.states),
+                        jax.tree.leaves(got.states)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_cnr_serve_frontend(self, mesh):
+        # the frontend serves the meshed CNR twin too: construction
+        # must record the worker→device map through replica_device
+        # (regression: getattr(nr, 'mesh') passed but the method was
+        # NR-only, crashing __init__)
+        from node_replication_tpu.serve import ServeConfig, ServeFrontend
+
+        _, got = self._pair()
+        with ServeFrontend(got, ServeConfig(batch_max_ops=8,
+                                            batch_linger_s=0.0)) as fe:
+            assert fe.call((HM_PUT, 3, 7, 0), rid=1) == 0
+            st = fe.stats()
+        assert len(st["mesh"]["device_of_rid"]) == 2
+        assert st["mesh"]["devices"] == 2  # one row device per shard
+
+    def test_cnr_validation(self, mesh):
+        mapper = lambda opc, args: args[0]
+        with pytest.raises(ValueError):  # L=3 can't shard over 4 cols
+            MultiLogReplicated(make_hashmap(8), mapper, nlogs=3,
+                               n_replicas=2, mesh=make_mesh(2, 4))
+        with pytest.raises(ValueError):  # R=3 can't shard over 2 rows
+            MultiLogReplicated(make_hashmap(8), mapper, nlogs=4,
+                               n_replicas=3, mesh=make_mesh(2, 4))
+        with pytest.raises(ValueError):  # not a ('replica','log') Mesh
+            MultiLogReplicated(make_hashmap(8), mapper, nlogs=4,
+                               n_replicas=2, mesh=4)

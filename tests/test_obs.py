@@ -339,7 +339,9 @@ class TestSnapshotSchemas:
         assert s["engine"] in ("combined", "scan")
         snap = nr.snapshot()
         json.dumps(snap)  # JSON-safe throughout
-        assert set(snap) == {"log", "replicas", "exec", "metrics"}
+        assert set(snap) == {"log", "replicas", "exec", "mesh",
+                             "metrics"}
+        assert snap["mesh"] is None  # un-meshed wrapper
         assert snap["log"]["tail"] == 5
         assert 0.0 <= snap["log"]["occupancy"] <= 1.0
         assert snap["replicas"]["n"] == 2
@@ -420,6 +422,53 @@ class TestReportCLI:
         assert data["throughput"]["timeline"] == {"0": 100, "1": 200}
         assert data["stalls"][0]["where"] == "sync"
         assert data["stalls"][0]["dormant"] == [1]
+
+    def test_mesh_section(self, tmp_path, capsys):
+        # a mesh-sharded fleet's trace renders the Mesh section:
+        # placement, rounds by collective tier, sync bytes, ring passes
+        import jax as _jax
+
+        from node_replication_tpu.obs import report
+
+        if len(_jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        from node_replication_tpu.core.log import log_append
+        from node_replication_tpu.models import SR_SET, make_seqreg
+        from node_replication_tpu.parallel import replica_mesh
+
+        path = tmp_path / "trace.jsonl"
+        t = get_tracer()
+        t.enable(str(path))
+        try:
+            nr = NodeReplicated(
+                make_seqreg(4), n_replicas=8, log_entries=1 << 12,
+                gc_slack=64, exec_window=32, mesh=replica_mesh(8),
+            )
+            tok = nr.register(0)
+            for i in range(8):
+                nr.execute_mut((SR_SET, i % 4, i), tok)
+            # a uniform backlog to drive the ring tier
+            import jax.numpy as _jnp
+
+            opc = _jnp.full(200, SR_SET, _jnp.int32)
+            args = _jnp.zeros((200, 3), _jnp.int32)
+            nr.log = log_append(nr.spec, nr.log, opc, args, 200)
+            nr.sync()
+        finally:
+            t.disable()
+        assert report.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "== mesh ==" in out
+        assert "NodeReplicated: 8 replica(s) over 8 device(s)" in out
+        assert "rounds by tier: shmap=" in out
+        assert "cross-device sync:" in out
+        assert "ring catch-up:" in out
+        assert report.main([str(path), "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["mesh"]["placements"][0]["tier"] == "shmap"
+        assert data["mesh"]["rounds_by_tier"]["shmap"] > 0
+        assert data["mesh"]["sync_bytes"] > 0
+        assert data["mesh"]["ring_execs"] > 0
 
     def test_timeline_derived_from_appends(self, tmp_path, capsys):
         from node_replication_tpu.obs import report
