@@ -237,6 +237,111 @@ def serve_main(args) -> int:
     return 0
 
 
+def kernel_main(args) -> int:
+    """`--kernel`: the combiner-round engine gate (ISSUE 11).
+
+    For each `RxKxW` point in `--kernel-points`, measures one combiner
+    round per tier — `pallas_fused` (the one-launch fused
+    append+replay engine, `ops/pallas_replay.py`) vs the `combined`
+    and `scan` append+exec chains — with BIT-IDENTITY verified against
+    the scan engine before any timing (states, cursors, ring content,
+    responses; `harness/mkbench.measure_kernel`). Per-round latency is
+    fenced, so the reported p50/p95 is the real per-batch latency
+    floor, and `launches_per_round` shows the chain-vs-fused launch
+    collapse.
+
+    Gates: ANY bit-identity failure exits 1 on every platform. On TPU
+    the flagship point (R=4096, K=10000) additionally requires
+    `pallas_fused >= combined` dispatches/s — the ROADMAP item-1
+    target; off-TPU (or `--kernel-interpret`) the throughput gate
+    self-skips, matching the `--mesh` baseline-gate convention.
+    """
+    from node_replication_tpu.harness.mkbench import (
+        append_kernel_csv,
+        kernel_rows,
+        measure_kernel,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    interpret = args.kernel_interpret or not on_tpu
+    failures: list[str] = []
+    results = []
+    csv_rows: list[dict] = []
+    for spec_str in args.kernel_points.split(","):
+        try:
+            R, K, W = (int(x) for x in spec_str.strip().split("x"))
+        except ValueError:
+            sys.exit(f"--kernel-points entry {spec_str!r} is not RxKxW")
+        try:
+            points = measure_kernel(
+                K, R, W, duration_s=args.kernel_duration,
+                interpret=interpret, seed=args.seed,
+            )
+        except ValueError as e:
+            failures.append(f"{spec_str}: {e}")
+            continue
+        by_tier = {p.tier: p for p in points}
+        for p in points:
+            if not p.bit_identical:
+                failures.append(
+                    f"{spec_str}: tier {p.tier} NOT bit-identical to "
+                    f"the scan engine"
+                )
+        gate = None
+        flagship = (R, K) == (4096, 10_000)
+        if flagship and not interpret:
+            fused = by_tier["pallas_fused"].dispatches_per_sec
+            comb = by_tier["combined"].dispatches_per_sec
+            gate = fused >= comb
+            if not gate:
+                failures.append(
+                    f"{spec_str}: fused {fused:.3g} dispatches/s < "
+                    f"combined {comb:.3g} on the flagship config"
+                )
+        results.append({
+            "point": spec_str.strip(),
+            "flagship": flagship,
+            "tiers": {
+                p.tier: {
+                    "dispatches_per_sec": round(
+                        p.dispatches_per_sec, 1),
+                    "launches_per_round": p.launches_per_round,
+                    "p50_ms": round(p.p50_ms, 4),
+                    "p95_ms": round(p.p95_ms, 4),
+                    "rounds": p.rounds,
+                    "bit_identical": p.bit_identical,
+                } for p in points
+            },
+            "fused_vs_combined_gate": gate,
+        })
+        csv_rows.extend(kernel_rows(f"bench/{spec_str.strip()}", points))
+    append_kernel_csv(args.serve_out, csv_rows)
+    print(json.dumps({
+        "metric": "kernel_round_engines",
+        "value": len(results),
+        "unit": "points",
+        "interpret": interpret,
+        "throughput_gate": (
+            "enforced" if (on_tpu and not interpret) else "skipped"
+        ),
+        "points": results,
+    }))
+    if not on_tpu or interpret:
+        print("# kernel throughput gate skipped (no TPU / interpret "
+              "mode); bit-identity still enforced", file=sys.stderr)
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# kernel OK: {len(results)} points, every tier "
+        f"bit-identical to scan"
+        + ("" if interpret else "; flagship fused>=combined gate held"),
+        file=sys.stderr,
+    )
+    return 0
+
+
 def mesh_main(args) -> int:
     """`--mesh`: the 1→N-device scaling curve (ISSUE 10).
 
@@ -1844,6 +1949,26 @@ def main():
                                "transients — 8 puts the window near "
                                "1s on a typical CPU runner")
 
+    kernel = p.add_argument_group(
+        "kernel", "combiner-round engine benchmark (--kernel): fused "
+                  "pallas round vs the combined/scan append+exec "
+                  "chains at each RxKxW point, bit-identity verified "
+                  "before timing; exits 1 on any divergence, and (on "
+                  "TPU) when fused < combined at the flagship point")
+    kernel.add_argument("--kernel", action="store_true",
+                        help="run the kernel-engine benchmark")
+    kernel.add_argument("--kernel-points",
+                        default="256x1024x512,1024x4096x1024,"
+                                "4096x10000x4096",
+                        help="comma-separated RxKxW points (replicas x "
+                             "keys x window); the flagship 4096x10000 "
+                             "point carries the fused>=combined gate")
+    kernel.add_argument("--kernel-duration", type=float, default=1.0,
+                        help="seconds of fenced timed rounds per tier")
+    kernel.add_argument("--kernel-interpret", action="store_true",
+                        help="force interpret-mode kernels (the CPU CI "
+                             "bit-identity pass; throughput gate "
+                             "self-skips)")
     mesh = p.add_argument_group(
         "mesh", "mesh scaling benchmark (--mesh): the flagship "
                 "hashmap 50/50 config at 1→N devices with the "
@@ -1961,9 +2086,10 @@ def main():
     if args.max_attempts < 1:
         p.error("--max-attempts must be >= 1")
     if sum(map(bool, (args.chaos, args.serve, args.crash,
-                      args.follower, args.overload, args.mesh))) > 1:
-        p.error("--chaos, --serve, --crash, --follower, --overload "
-                "and --mesh are mutually exclusive")
+                      args.follower, args.overload, args.mesh,
+                      args.kernel))) > 1:
+        p.error("--chaos, --serve, --crash, --follower, --overload, "
+                "--mesh and --kernel are mutually exclusive")
     if args.crash_child:
         if not args.crash_dir:
             p.error("--crash-child requires --crash-dir")
@@ -1985,6 +2111,8 @@ def main():
         sys.exit(overload_main(args))
     if args.mesh:
         sys.exit(mesh_main(args))
+    if args.kernel:
+        sys.exit(kernel_main(args))
     if args.pallas:
         if args.path not in ("auto", "pallas"):
             p.error(f"--pallas conflicts with --path {args.path}")

@@ -1477,3 +1477,121 @@ def host_transfer_in_sharded_path(
                     f"readback per call; keep the value symbolic or "
                     f"read back cursors instead",
                 )
+
+
+# --------------------------------------------------------------------------
+# aliased-pallas-planes
+# --------------------------------------------------------------------------
+
+
+def _is_blocked_spec(mod: ModuleInfo, node: ast.AST,
+                     aliases: dict[str, ast.AST]) -> bool:
+    """A `pl.BlockSpec(...)` whose first positional argument is a block
+    shape (i.e. a BLOCKED, grid-pipelined plane). Specs built with only
+    `memory_space=` (SMEM scalars, ANY/HBM refs moved by explicit
+    in-kernel DMA) are un-blocked and exempt. Names resolve one level
+    through the enclosing function's assignments; anything
+    unresolvable counts as not-blocked (no false positives)."""
+    if isinstance(node, ast.Name) and node.id in aliases:
+        node = aliases[node.id]
+    if not isinstance(node, ast.Call):
+        return False
+    callee = node.func
+    name = (
+        callee.attr if isinstance(callee, ast.Attribute)
+        else callee.id if isinstance(callee, ast.Name) else None
+    )
+    if name != "BlockSpec":
+        return False
+    if not node.args:
+        return False
+    kw = {k.arg for k in node.keywords if k.arg}
+    if "memory_space" in kw:
+        # blocked VMEM planes never carry a memory_space kwarg in this
+        # codebase; SMEM/ANY shaped specs (the shared-resp pattern) do
+        return False
+    return True
+
+
+def _grid_is_single(node: ast.AST | None,
+                    aliases: dict[str, ast.AST]) -> bool:
+    """grid=(1,) / grid=1 / absent: a single grid step has no pipeline
+    to race, which is exactly the plan kernels' sanctioned in-place
+    aliasing regime (ops/pallas_vspace.py)."""
+    if node is None:
+        return True
+    if isinstance(node, ast.Name) and node.id in aliases:
+        node = aliases[node.id]
+    if isinstance(node, ast.Constant):
+        return node.value == 1
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(
+            isinstance(e, ast.Constant) and e.value == 1
+            for e in node.elts
+        )
+    return False
+
+
+@rule(
+    "aliased-pallas-planes", ERROR,
+    "input_output_aliases on a blocked state plane of a multi-step-grid "
+    "pallas_call",
+)
+def aliased_pallas_planes(mod: ModuleInfo,
+                          project: Project) -> Iterator[Diagnostic]:
+    """The r5 silent-corruption pattern, machine-checked
+    (`ops/pallas_chunk.py`): a `pl.pallas_call` whose BLOCKED state
+    planes are aliased in->out corrupts state once the grid pipelines
+    deep enough — Mosaic's block prefetch for a later grid step races
+    the writeback of an earlier one, and the misread is silent (always
+    at >= 64 grid steps on v5e, occasionally at 32, never in interpret
+    mode, so no CPU test catches it). The sanctioned shapes stay
+    clean: separate in/out planes with an in-kernel copy (the span
+    kernels), aliasing under `grid=(1,)` (the plan kernels — one grid
+    step, no pipeline), and aliasing of UN-BLOCKED refs
+    (`memory_space=ANY/HBM` moved by explicit DMA — the fused round's
+    ring planes, `ops/pallas_ring.py`). Scoped to ops/, where every
+    kernel lives."""
+    parts = re.split(r"[\\/]+", mod.path)
+    if "ops" not in parts[:-1]:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        name = (
+            callee.attr if isinstance(callee, ast.Attribute)
+            else callee.id if isinstance(callee, ast.Name) else None
+        )
+        if name != "pallas_call":
+            continue
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        al = kw.get("input_output_aliases")
+        if not isinstance(al, ast.Dict):
+            continue
+        aliases = _local_aliases(mod, node)
+        if _grid_is_single(kw.get("grid"), aliases):
+            continue
+        in_specs = kw.get("in_specs")
+        if isinstance(in_specs, ast.Name) and in_specs.id in aliases:
+            in_specs = aliases[in_specs.id]
+        if not isinstance(in_specs, (ast.List, ast.Tuple)):
+            continue  # unresolvable spec list: stay silent
+        for key_node in al.keys:
+            if not (isinstance(key_node, ast.Constant)
+                    and isinstance(key_node.value, int)):
+                continue
+            idx = key_node.value
+            if not 0 <= idx < len(in_specs.elts):
+                continue
+            if _is_blocked_spec(mod, in_specs.elts[idx], aliases):
+                yield _diag(
+                    mod, key_node, "aliased-pallas-planes",
+                    f"pallas_call aliases BLOCKED input {idx} in-place "
+                    f"on a multi-step grid — the r5 pipeline "
+                    f"prefetch/writeback race silently corrupts state "
+                    f"on hardware; use separate in/out planes with an "
+                    f"in-kernel copy (ops/pallas_chunk.py), or an "
+                    f"un-blocked ANY/HBM ref with explicit DMA "
+                    f"(ops/pallas_ring.py)",
+                )

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from functools import partial
 from typing import Any, Callable
@@ -26,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from node_replication_tpu.core.log import WARN_ROUNDS
+from node_replication_tpu.core.log import LogSpec, LogState, WARN_ROUNDS
 from node_replication_tpu.core.multilog import (
     LogMapper,
     MultiLogSpec,
@@ -38,6 +39,7 @@ from node_replication_tpu.core.replica import (
     MAX_THREADS_PER_REPLICA,
     LogTooSmallError,
     ReplicaToken,
+    _FusedTier,
     _locked,
     replicate_state,
     states_equal,
@@ -50,7 +52,7 @@ from node_replication_tpu.utils.trace import get_tracer, span
 logger = logging.getLogger("node_replication_tpu")
 
 
-class MultiLogReplicated:
+class MultiLogReplicated(_FusedTier):
     """N replicas of one `Dispatch` behind L commutativity-partitioned logs."""
 
     def __init__(
@@ -64,6 +66,7 @@ class MultiLogReplicated:
         exec_window: int = 128,
         gc_callback: Callable[[int, int], None] | None = None,
         mesh=None,
+        engine: str = "auto",
     ):
         self.spec = MultiLogSpec(
             nlogs=nlogs,
@@ -152,6 +155,18 @@ class MultiLogReplicated:
         self._m_batch = reg.histogram("cnr.combine.batch_size",
                                       buckets=COUNT_BUCKETS)
         self._m_stalls = reg.counter("cnr.watchdog.stalls")
+
+        # ---- fused pallas per-log combiner tier (the NodeReplicated
+        # twin, `core/replica._FusedTier`): a per-log sub-batch whose
+        # log is lock-step eligible appends+replays+answers as ONE
+        # kernel launch. engine='pallas' forces it, 'auto' calibrates
+        # on TPU (NR_TPU_FUSED_CAL=1 is the CPU-test hook), 'scan'
+        # keeps the chain. CNR has no fencing, so the fenced kernel
+        # variant never builds here.
+        if engine not in ("auto", "scan", "pallas"):
+            raise ValueError(f"unknown engine {engine!r}")
+        self._fused_cnr_cache: dict = {}
+        self._init_fused_tier(engine, dispatch, mesh, reg, "cnr")
         if self.mesh is not None:
             self._m_mesh_round = reg.counter("cnr.exec.mesh.gspmd")
             self._m_mesh_sync_bytes = reg.counter("mesh.sync_bytes")
@@ -338,6 +353,113 @@ class MultiLogReplicated:
             return
         self._append_and_replay_log(log_idx, rid, ops, tids)
 
+    def _fused_log_spec(self) -> LogSpec:
+        """The single-log `LogSpec` the fused engine is built against —
+        every CNR log shares it (same capacity/slack), so ONE engine
+        serves all per-log rounds."""
+        return LogSpec(
+            capacity=self.spec.capacity,
+            n_replicas=self.spec.n_replicas,
+            arg_width=self.spec.arg_width,
+            gc_slack=self.spec.gc_slack,
+        )
+
+    @_locked
+    def _fused_cnr_round(self, eng, window: int):
+        """Per-window fused round over ONE mapped log: view the log's
+        column of the stacked `MultiLogState` as a `LogState`, run the
+        engine's model-layout round, write the column back. `log_idx`
+        is a traced operand so one program serves every log."""
+        fn = self._fused_cnr_cache.get(window)
+        if fn is None:
+            inner = eng.round_fn(window, fenced=False)
+
+            def cnr_round(ml, states, log_idx, opcodes, args, count):
+                log = LogState(
+                    opcodes=ml.opcodes[log_idx],
+                    args=ml.args[log_idx],
+                    head=ml.head[log_idx],
+                    tail=ml.tail[log_idx],
+                    ctail=ml.ctail[log_idx],
+                    ltails=ml.ltails[log_idx],
+                )
+                log, states, resps = inner(
+                    log, states, opcodes, args, count
+                )
+                ml = ml._replace(
+                    opcodes=ml.opcodes.at[log_idx].set(log.opcodes),
+                    args=ml.args.at[log_idx].set(log.args),
+                    head=ml.head.at[log_idx].set(log.head),
+                    tail=ml.tail.at[log_idx].set(log.tail),
+                    ctail=ml.ctail.at[log_idx].set(log.ctail),
+                    ltails=ml.ltails.at[log_idx].set(log.ltails),
+                )
+                return ml, states, resps
+
+            # interpret mode runs eagerly (jit+interpret+x64 trips the
+            # MLIR dtype mismatch — see FusedHashmapEngine.round)
+            fn = (
+                cnr_round if eng.interpret
+                else jax.jit(cnr_round, donate_argnums=(0, 1))
+            )
+            self._fused_cnr_cache[window] = fn
+        return fn
+
+    @_locked
+    def _try_fused_round_log(self, log_idx: int, rid: int, ops, tids,
+                             n: int, pos0: int, pad: int,
+                             opcodes, args) -> bool:
+        """Route one per-log combiner pass through the fused engine
+        when the log is lock-step eligible (the NodeReplicated
+        `_try_fused_round` twin, minus fencing/WAL, which CNR does not
+        carry)."""
+        eng = self._fused_tier_wanted(pad)
+        if eng is None:
+            return False
+        if not eng.supports(pad):
+            self._m_fused_fallback.inc()
+            return False
+        if any(self._inflight.get((r, log_idx))
+               for r in range(self.n_replicas)):
+            self._m_fused_fallback.inc()
+            return False
+        cur = np.asarray(
+            jnp.concatenate(
+                [self.ml.ltails[log_idx], self.ml.tail[log_idx][None]]
+            )
+        ).copy()
+        lts, tail = cur[:-1], int(cur[-1])
+        if not (int(lts.min()) == tail == int(lts.max())):
+            self._m_fused_fallback.inc()
+            return False
+        timing = (self._fused_mode == "auto"
+                  and self._fused_choice is None)
+        t0 = time.perf_counter()
+        fn = self._fused_cnr_round(eng, pad)
+        with span("fused-round", log=log_idx, rid=rid, n=n, pos0=pos0,
+                  window=pad) as sp:
+            self.ml, self.states, resps = fn(
+                self.ml, self.states, jnp.int32(log_idx), opcodes,
+                args, n,
+            )
+            resps_np = np.asarray(resps)
+            sp.fence(self.ml, self.states)
+        dt = time.perf_counter() - t0
+        if timing:
+            self._note_fused_sample("pallas_fused", pad, dt)
+        # the CNR path embeds the raw round_fn in its own program, so
+        # the engine's round() wrapper never runs — report through the
+        # same instrumentation hook (tier counter + kernel.* metrics +
+        # kernel-launch event; one contract, never two)
+        eng.note_round(pad, n, dt)
+        for j, tid in enumerate(tids):
+            self._resps[(rid, tid)].append(int(resps_np[rid, j]))
+        self._fused_rounds += 1
+        self._m_engine_fused.inc()
+        self.last_round_tier = "pallas_fused"
+        self._tier_by_rid[rid] = "pallas_fused"
+        return True
+
     @_locked
     def _append_and_replay_log(self, log_idx: int, rid: int,
                                ops: list[tuple], tids: list[int],
@@ -347,7 +469,9 @@ class MultiLogReplicated:
         wait for ring space on this log, encode + append, record each
         op's in-flight response destination, replay the log until
         replica `rid` has applied its own ops. The lock is reentrant:
-        callers already hold it."""
+        callers already hold it. Lock-step-eligible passes route
+        through the fused pallas tier when selected
+        (`_try_fused_round_log`) — one kernel launch per sub-batch."""
         fault_hook("append", rid, self)
         n = len(ops)
         self._combine_rounds[log_idx] += 1
@@ -365,6 +489,12 @@ class MultiLogReplicated:
         opcodes, args, _ = encode_ops(
             ops, self.spec.arg_width, pad_to=pad
         )
+        if self._try_fused_round_log(log_idx, rid, ops, tids, n, pos0,
+                                     pad, opcodes, args):
+            return
+        timing = (self._fused_mode == "auto"
+                  and self._fused_choice is None)
+        t_chain = time.perf_counter()
         extra = {"batch": True} if batch else {}
         with span("append", log=log_idx, rid=rid, n=n, pos0=pos0,
                   **extra) as sp:
@@ -383,6 +513,11 @@ class MultiLogReplicated:
                 self._exec_round(log_idx)
                 rounds = self._watchdog(rounds, log_idx, "combine-replay")
             sp.fence(self.ml, self.states)
+        self.last_round_tier = "scan"
+        self._tier_by_rid[rid] = "scan"
+        if timing:
+            self._note_fused_sample("chain", pad,
+                                    time.perf_counter() - t_chain)
 
     @_locked
     def execute_mut_batch(self, ops: list[tuple],
@@ -495,6 +630,8 @@ class MultiLogReplicated:
             "combine_rounds": list(self._combine_rounds),
             "exec_rounds": self._exec_rounds,
             "idle_rounds": self._idle_rounds,
+            "fused_rounds": self._fused_rounds,
+            "fused_tier": self._fused_tier_state(),
         }
 
     @_locked
@@ -540,6 +677,8 @@ class MultiLogReplicated:
                 "window": self.exec_window,
                 "rounds": self._exec_rounds,
                 "idle_rounds": self._idle_rounds,
+                "fused_rounds": self._fused_rounds,
+                "fused_tier": self._fused_tier_state(),
             },
             "mesh": (
                 None if self.mesh is None else {

@@ -73,6 +73,13 @@ _m_idle_skips = get_registry().counter("log.engine.idle_skip")
 # (make_shmap_exec — counted per build, like the per-trace counters
 # above; per-ROUND mesh usage is the wrapper's nr.exec.mesh.* family)
 _m_engine_shmap = get_registry().counter("log.engine.shmap")
+# fused pallas tier: whole combiner rounds (append + replay + response
+# gather) executed as one kernel launch (`ops/pallas_replay.py:
+# FusedHashmapEngine`, routed by `core/replica._try_fused_round` /
+# the CNR twin). Counted per ROUND on the host side of the jit
+# boundary — fused rounds are host-invoked, so unlike the per-trace
+# counters above this one is an exact round count.
+_m_engine_pallas_fused = get_registry().counter("log.engine.pallas_fused")
 
 # Default number of log entries. The reference defaults to 32 MiB of 64-byte
 # entries = 2^19 slots "based on the ASPLOS 2017 paper" (`nr/src/log.rs:19-22`);

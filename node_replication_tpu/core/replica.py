@@ -36,6 +36,8 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import os
+import statistics
 import threading
 import time
 from collections import deque
@@ -74,6 +76,15 @@ MAX_THREADS_PER_REPLICA = 256
 
 # Default static replay window per device round (jit-compiled once).
 DEFAULT_EXEC_WINDOW = 256
+
+# Fused-tier winner selection (`engine='auto'` + a fused-capable
+# dispatch): per tier, the first WARMUP eligible rounds absorb compile
+# cost off the books, the next SAMPLES rounds are timed (fenced by the
+# round's own host readback), and the tier with the lower median
+# commits. Both calibration tiers run REAL rounds — results are
+# bit-identical either way, only their speed differs.
+FUSED_CAL_WARMUP = 1
+FUSED_CAL_SAMPLES = 2
 
 # Reserved context key for `execute_mut_batch` response sinks: real
 # thread ids are allocated from 0 upward by `register`, so -1 can never
@@ -199,7 +210,177 @@ def states_equal(states) -> bool:
     )
 
 
-class NodeReplicated:
+class _FusedTier:
+    """Fused-pallas-tier plumbing shared by `NodeReplicated` and
+    `MultiLogReplicated` (`core/cnr.py`): lazy spec-bound engine
+    construction, the calibration sampler, and the winner-selection
+    state machine. Hosts expect the attributes initialized by their
+    constructors (`_fused_mode`, `_fused_choice`, `_fused_samples`,
+    `_fused`, `_fused_spec`) and provide `_fused_log_spec()` — the
+    `LogSpec` the engine is built against (a CNR derives one per-log
+    spec for all its logs). All methods run under the host's combiner
+    lock."""
+
+    def _fused_log_spec(self) -> LogSpec:
+        return self.spec
+
+    def _init_fused_tier(self, engine: str, dispatch, mesh, reg,
+                         prefix: str, debug: bool = False) -> None:
+        """Initialize the tier state + counters and resolve the mode —
+        the one constructor block both wrappers share. `engine='pallas'`
+        FORCES the tier (validated loudly here: the model must carry a
+        `fused_factory`, and neither `mesh=` nor checkify `debug` has a
+        fused twin); `engine='auto'` with a fused-capable dispatch arms
+        the measured calibration on TPU (NR_TPU_FUSED_CAL=1 is the
+        CPU-test hook — in interpret mode the fused tier cannot
+        honestly win); anything else leaves the tier off."""
+        self._fused = None
+        self._fused_spec = None
+        self._fused_mode = "off"
+        self._fused_choice: bool | None = False
+        # calibration samples are keyed by WINDOW (the padded batch
+        # size): chain and fused timings are only comparable at the
+        # same window, and the per-window warmup absorbs each window's
+        # jit compile — the verdict commits at the first window that
+        # fills both sides (see _note_fused_sample)
+        self._fused_samples: dict[str, dict[int, list]] = {
+            "pallas_fused": {}, "chain": {},
+        }
+        self._fused_rounds = 0
+        self.last_round_tier: str | None = None
+        self._tier_by_rid: dict[int, str] = {}
+        self._m_engine_fused = reg.counter(
+            f"{prefix}.exec.engine.pallas_fused"
+        )
+        self._m_fused_fallback = reg.counter(
+            f"{prefix}.exec.engine.fused_fallback"
+        )
+        if engine == "pallas":
+            if dispatch.fused_factory is None:
+                raise ValueError(
+                    f"engine='pallas' but {dispatch.name} has no "
+                    f"fused_factory (no fused kernel for this model)"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "engine='pallas' does not take mesh= (the fused "
+                    "tier runs un-meshed; its chunk layout is "
+                    "P('replica')-shardable but the shmap wiring is "
+                    "not routed yet — see README 'Engines')"
+                )
+            if debug:
+                raise ValueError(
+                    "engine='pallas' has no checkify twin; use "
+                    "debug=False (the fused round replays inside the "
+                    "kernel, outside the checks' reach)"
+                )
+            # build eagerly so an unsupported config fails loudly at
+            # construction (the explicit ask), not mid-traffic
+            spec = self._fused_log_spec()
+            self._fused = dispatch.fused_factory(spec)
+            self._fused_spec = spec
+            self._fused_mode = "forced"
+            self._fused_choice = True
+        elif (
+            engine == "auto"
+            and dispatch.fused_factory is not None
+            and mesh is None
+            and not debug
+            and (jax.default_backend() == "tpu"
+                 or os.environ.get("NR_TPU_FUSED_CAL") == "1")
+        ):
+            self._fused_mode = "auto"
+            self._fused_choice = None  # calibration pending
+
+    def _fused_engine(self):
+        """Lazily (re)build the dispatch's fused engine for the CURRENT
+        spec (fleet growth rebinds it). A factory rejection after a
+        shape change degrades the tier to off with a warning rather
+        than killing live traffic."""
+        if self._fused_mode == "off":
+            return None
+        spec = self._fused_log_spec()
+        if self._fused is None or self._fused_spec != spec:
+            try:
+                self._fused = self.dispatch.fused_factory(spec)
+                self._fused_spec = spec
+            except ValueError as e:
+                logger.warning(
+                    "fused engine rejected spec after fleet change "
+                    "(%s); falling back to the ordinary chain", e
+                )
+                self._fused_mode = "off"
+                self._fused_choice = False
+                return None
+        return self._fused
+
+    def _fused_tier_wanted(self, pad: int):
+        """The engine to route a `pad`-window round through, or None
+        for the ordinary chain. During auto calibration the chain goes
+        first AT EACH WINDOW (its programs are the already-compiled
+        steady state), then the fused tier collects that window's own
+        samples — mixing windows would compare incomparable rounds."""
+        if self._fused_mode == "off" or self._fused_choice is False:
+            return None
+        if self._fused_mode == "auto" and self._fused_choice is None:
+            need = FUSED_CAL_WARMUP + FUSED_CAL_SAMPLES
+            if len(self._fused_samples["chain"].get(pad, ())) < need:
+                return None
+        return self._fused_engine()
+
+    def _note_fused_sample(self, tier: str, pad: int,
+                           dt: float) -> None:
+        need = FUSED_CAL_WARMUP + FUSED_CAL_SAMPLES
+        samples = self._fused_samples[tier].setdefault(pad, [])
+        if len(samples) < need:
+            samples.append(dt)
+        # the verdict commits at the FIRST window whose chain and
+        # fused sides are both full: same-window samples only, and
+        # each side's warmup absorbed that window's compile
+        chain = self._fused_samples["chain"].get(pad, ())
+        fused = self._fused_samples["pallas_fused"].get(pad, ())
+        if len(chain) < need or len(fused) < need:
+            return
+        med_c = statistics.median(chain[FUSED_CAL_WARMUP:])
+        med_f = statistics.median(fused[FUSED_CAL_WARMUP:])
+        self._fused_choice = med_f <= med_c
+        get_tracer().emit(
+            "fused-calibration", window=pad,
+            fused_s=med_f, chain_s=med_c,
+            winner="pallas_fused" if self._fused_choice else "chain",
+        )
+
+    def _reset_fused_calibration(self) -> None:
+        """Fleet-shape change under engine='auto': the committed
+        verdict was measured at the OLD (R, capacity) point — drop it
+        and recalibrate at the new one."""
+        if self._fused_mode == "auto":
+            self._fused_choice = None
+            self._fused_samples = {"pallas_fused": {}, "chain": {}}
+
+    def round_tier(self, rid: int) -> str | None:
+        """The engine tier that served replica `rid`'s most recent
+        combiner round — per-rid, so concurrent serve workers cannot
+        misattribute each other's rounds (`last_round_tier` is the
+        wrapper-wide convenience for single-driver callers). For a CNR
+        batch spanning several logs this is the LAST sub-batch's
+        tier."""
+        return self._tier_by_rid.get(rid)
+
+    def _fused_tier_state(self) -> str:
+        """Human-readable fused-tier state for stats()/snapshot()."""
+        if self._fused_mode == "off":
+            return "off"
+        if self._fused_mode == "forced":
+            return "forced"
+        if self._fused_choice is None:
+            return "calibrating"
+        return (
+            "auto:pallas_fused" if self._fused_choice else "auto:chain"
+        )
+
+
+class NodeReplicated(_FusedTier):
     """N replicas of one `Dispatch` data structure behind a shared log.
 
     Mirrors the user-facing surface of `Replica` + `Log` wiring from the
@@ -291,7 +472,7 @@ class NodeReplicated:
         # the model provides a combined form. Off-trajectory hand-built
         # states must not use 'combined' (see log_catchup_all's
         # `on_trajectory`).
-        if engine not in ("auto", "combined", "scan"):
+        if engine not in ("auto", "combined", "scan", "pallas"):
             raise ValueError(f"unknown engine {engine!r}")
         if (dispatch.window_plan is None) != (
             dispatch.window_merge is None
@@ -320,8 +501,12 @@ class NodeReplicated:
             or (dispatch.window_plan is not None
                 and dispatch.window_canonical)
         )
+        # engine='pallas' forces the FUSED tier for combiner rounds;
+        # the catch-up loops below it still need a divergent-cursor
+        # engine, resolved exactly as 'auto' would
         use_combined = (
-            auto_combined if engine == "auto" else engine == "combined"
+            auto_combined if engine in ("auto", "pallas")
+            else engine == "combined"
         )
         self.engine = "combined" if use_combined else "scan"
         # engine='combined' is the caller EXPLICITLY asserting the
@@ -332,6 +517,18 @@ class NodeReplicated:
         # per-round engine usage (host truth for the wrapper; core/log.py
         # counts per-trace selections of the inner tiers)
         self._m_engine = reg.counter(f"nr.exec.engine.{self.engine}")
+
+        # ---- fused pallas combiner-round tier (ops/pallas_replay) ----
+        # One kernel launch per combiner round: append + replay +
+        # response gather fused into a single program, replacing the
+        # append-jit → exec-jit chain (and its per-round host syncs)
+        # when the round is lock-step eligible. Mode resolution +
+        # winner-selection calibration: `_FusedTier` (shared with the
+        # CNR twin). The tier never changes results — it is
+        # differentially pinned bit-identical to the scan engine
+        # (tests/test_pallas_fused.py) — only the launch count.
+        self._init_fused_tier(engine, dispatch, mesh, reg, "nr",
+                              debug=self.debug)
 
         # ---- mesh placement (parallel/): shard the replica axis -----
         # `mesh` puts the fleet across devices: states (and ltails)
@@ -456,6 +653,11 @@ class NodeReplicated:
         self._shmap_cache: dict = {}
         self._ring_fn = None
         self._ring_gather = None
+        # the fused engine is spec-bound (R, capacity): rebuild lazily
+        # after any fleet-shape change — and an auto-mode verdict
+        # measured at the old shape no longer applies (recalibrate)
+        self._fused = None
+        self._reset_fused_calibration()
         dispatch = self.dispatch
         exec_fn = (
             partial(log_catchup_all, union=self._union)
@@ -925,6 +1127,77 @@ class NodeReplicated:
         self._append_and_replay(ops, rid, tids)
 
     @_locked
+    def _try_fused_round(self, ops, rid, tids, n, pos0, pad,
+                         opcodes, args) -> bool:
+        """Route one combiner round through the fused engine when
+        eligible; False falls back to the append+exec chain. The
+        eligibility is exactly the lock-step precondition the fused
+        kernel requires, checked host-side against one fused cursor
+        readback: every LIVE cursor at the pre-append tail, no
+        in-flight responses owed (the fused round delivers only its
+        own batch), and a window the engine's ring-span append
+        supports. Results are bit-identical to the chain either way;
+        only launch count and latency differ."""
+        eng = self._fused_tier_wanted(pad)
+        if eng is None:
+            return False
+        if self._fenced is not None and not eng.supports_fenced:
+            self._m_fused_fallback.inc()
+            return False
+        if not eng.supports(pad):
+            self._m_fused_fallback.inc()
+            return False
+        if any(self._inflight):
+            self._m_fused_fallback.inc()
+            return False
+        cur = np.asarray(
+            jnp.concatenate([self.log.ltails, self.log.tail[None]])
+        ).copy()
+        lts, tail = cur[:-1], int(cur[-1])
+        live = lts if self._fenced is None else lts[~self._fenced]
+        if not (live.size
+                and int(live.min()) == tail == int(live.max())):
+            self._m_fused_fallback.inc()
+            return False
+        # tail == pos0: the GC-help loop never appends
+        timing = (self._fused_mode == "auto"
+                  and self._fused_choice is None)
+        t0 = time.perf_counter()
+        fenced = self._fenced
+        with span("fused-round", rid=rid, n=n, pos0=pos0,
+                  window=pad) as sp:
+            self.log, self.states, resps = eng.round(
+                self.log, self.states, opcodes, args, n, fenced=fenced
+            )
+            # the response readback is also the round's host fence:
+            # delivery below needs the values, and the calibration
+            # timing needs completed device work
+            resps_np = np.asarray(resps)
+            sp.fence(self.log, self.states)
+        if timing:
+            self._note_fused_sample(
+                "pallas_fused", pad, time.perf_counter() - t0
+            )
+        if self._wal is not None:
+            # same order as the chain: journal once the ops ARE in the
+            # in-memory log, before any response is delivered
+            self._wal.append(pos0, ops)
+            if fenced is None or not fenced.any():
+                floor = pos0 + n
+            else:
+                floor = min(int(lts[fenced].min()), pos0 + n)
+            self._wal.maybe_reclaim(floor)
+        for j, tid in enumerate(tids):
+            self._contexts[(rid, tid)].enqueue_resps(
+                [int(resps_np[rid, j])]
+            )
+        self._fused_rounds += 1
+        self._m_engine_fused.inc()
+        self.last_round_tier = "pallas_fused"
+        self._tier_by_rid[rid] = "pallas_fused"
+        return True
+
+    @_locked
     def _append_and_replay(self, ops: list[tuple], rid: int,
                            tids: list[int], batch: bool = False) -> None:
         """Shared combiner-round tail (one protocol, every caller):
@@ -933,7 +1206,13 @@ class NodeReplicated:
         until replica `rid` has applied its own ops. `combine`,
         `execute_mut_batch`, and nothing else — serve-path and
         thread-context rounds must never diverge. The lock is
-        reentrant: callers already hold it."""
+        reentrant: callers already hold it.
+
+        When the fused pallas tier is selected and the round is
+        lock-step eligible, the whole tail — append, replay, response
+        gather — runs as ONE kernel launch instead
+        (`_try_fused_round`); the WAL journaling, response-delivery
+        order, and cursor lattice are identical by construction."""
         if self._is_fenced(rid):
             # a fenced replica's replay is frozen: waiting for it to
             # apply its own batch would hang forever — fail fast, the
@@ -957,6 +1236,12 @@ class NodeReplicated:
         opcodes, args, _ = encode_ops(
             ops, self.spec.arg_width, pad_to=pad
         )
+        if self._try_fused_round(ops, rid, tids, n, pos0, pad,
+                                 opcodes, args):
+            return
+        timing = (self._fused_mode == "auto"
+                  and self._fused_choice is None)
+        t_chain = time.perf_counter()
         extra = {"batch": True} if batch else {}
         with span("append", rid=rid, n=n, pos0=pos0, **extra) as sp:
             self.log = self._append_call(opcodes, args, n)
@@ -982,6 +1267,13 @@ class NodeReplicated:
                 self._exec_round()
                 rounds = self._watchdog(rounds, "combine-replay")
             sp.fence(self.log, self.states)
+        self.last_round_tier = self.engine
+        self._tier_by_rid[rid] = self.engine
+        if timing:
+            # the replay loop's cursor readbacks serialize the chain,
+            # so the wall delta is an honest device-time sample
+            self._note_fused_sample("chain", pad,
+                                    time.perf_counter() - t_chain)
 
     @_locked
     def execute_mut_batch(self, ops: list[tuple],
@@ -1214,6 +1506,8 @@ class NodeReplicated:
             "idle_rounds": self._idle_rounds,
             "ring_rounds": self._ring_rounds,
             "engine": self.engine,
+            "fused_rounds": self._fused_rounds,
+            "fused_tier": self._fused_tier_state(),
             "mesh_devices": self._mesh_shards,
             "max_lag": tail - int(ltails.min()),
         }
@@ -1255,6 +1549,8 @@ class NodeReplicated:
                 "rounds": self._exec_rounds,
                 "idle_rounds": self._idle_rounds,
                 "ring_rounds": self._ring_rounds,
+                "fused_rounds": self._fused_rounds,
+                "fused_tier": self._fused_tier_state(),
             },
             "mesh": (
                 # shard shape only: a per-rid device dict would be

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import csv
 import dataclasses
+import functools
 import os
 import random
 import threading
@@ -1008,6 +1009,284 @@ def mesh_rows(name: str, points: list[MeshPoint], batch: int,
 
 def append_mesh_csv(out_dir: str, rows: list[dict]) -> None:
     _append_csv(os.path.join(out_dir, MESH_CSV), _MESH_FIELDS, rows)
+
+
+# --------------------------------------------------------------- kernel
+KERNEL_CSV = "kernel_benchmarks.csv"
+_KERNEL_FIELDS = [
+    "name", "tier", "replicas", "keys", "window", "capacity", "rounds",
+    "duration", "dispatches_per_sec", "launches_per_round", "p50_ms",
+    "p95_ms", "bit_identical", "interpret",
+]
+
+
+@dataclasses.dataclass
+class KernelPoint:
+    """One (config, tier) measurement of the combiner-round engines
+    (`bench.py --kernel`): fused pallas round vs the append+exec chain
+    on the combined and scan engines, bit-identity verified BEFORE any
+    timing (a fast wrong kernel is worthless)."""
+
+    tier: str
+    n_replicas: int
+    n_keys: int
+    window: int
+    capacity: int
+    rounds: int
+    duration_s: float
+    dispatches_per_sec: float
+    launches_per_round: int
+    p50_ms: float
+    p95_ms: float
+    bit_identical: bool
+    interpret: bool
+
+
+def _kernel_batches(n_keys: int, window: int, arg_width: int, seed: int,
+                    count: int = 8):
+    """Seeded full-window PUT/REMOVE batches (NOOP-free: every slot
+    live, the flagship round shape)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    batches = []
+    for _ in range(count):
+        opc = np.where(rng.rand(window) < 0.7, 1, 2).astype(np.int32)
+        args = np.zeros((window, arg_width), np.int32)
+        args[:, 0] = rng.randint(0, n_keys, window)
+        args[:, 1] = rng.randint(0, 1 << 20, window)
+        batches.append((jnp.asarray(opc), jnp.asarray(args)))
+    return batches
+
+
+def measure_kernel(
+    n_keys: int,
+    n_replicas: int,
+    window: int,
+    duration_s: float = 1.0,
+    tiers: Sequence[str] = ("pallas_fused", "combined", "scan"),
+    interpret: bool | None = None,
+    verify_rounds: int = 4,
+    seed: int = 0,
+) -> list[KernelPoint]:
+    """Measure one (R, K, W) point across the combiner-round tiers.
+
+    Chain tiers (`combined`/`scan`) run the round the wrapper's
+    `_append_and_replay` actually runs: an append program, a host
+    boundary, then one exec program over the appended window — 2
+    launches per round. The `pallas_fused` tier runs the
+    `FusedHashmapEngine` raw round with TRANSPOSED-RESIDENT state
+    (state stays in kernel layout across rounds — the flagship
+    configuration), usually 1 launch.
+
+    Before any timing, every tier replays `verify_rounds` identical
+    batches from identical init and must match the SCAN tier bit-
+    for-bit: model-layout states, every log cursor, the ring content,
+    and per-round responses. Per-round latency (p50/p95) is fenced —
+    each timed round ends on a real device fence (`utils/fence.py`),
+    so the per-batch latency floor is honest, not dispatch-rate
+    fiction.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from node_replication_tpu.core.log import (
+        LogSpec,
+        log_append,
+        log_catchup_all,
+        log_exec_all,
+        log_init,
+    )
+    from node_replication_tpu.core.replica import replicate_state
+    from node_replication_tpu.models import make_hashmap
+    from node_replication_tpu.utils.fence import fence
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    W = int(window)
+    spec = LogSpec(
+        capacity=max(4 * W, 512), n_replicas=n_replicas, arg_width=3,
+        gc_slack=min(128, W),
+    )
+    d = make_hashmap(n_keys)
+    batches = _kernel_batches(n_keys, W, spec.arg_width, seed)
+    S = len(batches)
+
+    def make_chain(engine: str):
+        append_jit = jax.jit(
+            functools.partial(log_append, spec), donate_argnums=(0,)
+        )
+        exec_fn = log_exec_all if engine == "scan" else log_catchup_all
+
+        def exec_round(log, states):
+            return exec_fn(spec, d, log, states, window=W)
+
+        exec_jit = jax.jit(exec_round, donate_argnums=(0, 1))
+
+        class Chain:
+            launches = 2
+
+            def __init__(self):
+                self.reset()
+
+            def reset(self):
+                # fresh fleet, SAME compiled programs: the timing
+                # phase reuses the verify phase's jits instead of
+                # paying every compile twice per point
+                self.log = log_init(spec)
+                self.states = replicate_state(d.init_state(),
+                                              n_replicas)
+
+            def round(self, opc, args):
+                # the wrapper's chain shape: append program, host
+                # boundary, exec program
+                self.log = append_jit(self.log, opc, args, W)
+                self.log, self.states, resps = exec_jit(
+                    self.log, self.states
+                )
+                return resps
+
+            def model_states(self):
+                return self.states
+
+            def fence_all(self):
+                fence(self.log, self.states)
+
+        return Chain()
+
+    def make_fused():
+        eng = d.fused_factory(spec, interpret=interpret)
+        if not eng.supports(W):
+            raise ValueError(
+                f"fused engine rejects window {W} at capacity "
+                f"{spec.capacity}"
+            )
+        raw = eng.raw_round(W)
+        run = raw if interpret else jax.jit(raw, donate_argnums=(0,))
+        K = n_keys
+        kp = eng.kp
+
+        class Fused:
+            launches = eng.launches(W)
+
+            def __init__(self):
+                self.reset()
+
+            def reset(self):
+                self.log = log_init(spec)
+                st = replicate_state(d.init_state(), n_replicas)
+                self.vals = jnp.zeros((kp, n_replicas), jnp.int32).at[
+                    :K].set(st["values"].T)
+                self.pres = jnp.zeros_like(self.vals).at[:K].set(
+                    st["present"].T.astype(jnp.int32)
+                )
+
+            def round(self, opc, args):
+                self.log, self.vals, self.pres, resps = run(
+                    self.log, self.vals, self.pres, opc, args, W
+                )
+                return resps.T  # [R, W], the chain layout
+
+            def model_states(self):
+                return {
+                    "values": self.vals[:K].T,
+                    "present": self.pres[:K].T > 0,
+                }
+
+            def fence_all(self):
+                fence(self.log, self.vals, self.pres)
+
+        return Fused()
+
+    def build(tier: str):
+        return make_fused() if tier == "pallas_fused" else \
+            make_chain(tier)
+
+    # ---- bit-identity BEFORE timing (scan is the reference) --------
+    ref = make_chain("scan")
+    ref_resps = []
+    for i in range(verify_rounds):
+        ref_resps.append(np.asarray(ref.round(*batches[i % S])))
+    ref.fence_all()
+    ref_states = [np.asarray(a)
+                  for a in jax.tree.leaves(ref.model_states())]
+    ref_log = jax.tree.map(np.asarray, ref.log)
+
+    points: list[KernelPoint] = []
+    for tier in tiers:
+        runner = build(tier)
+        ok = True
+        for i in range(verify_rounds):
+            got = np.asarray(runner.round(*batches[i % S]))
+            if not np.array_equal(got, ref_resps[i]):
+                ok = False
+        runner.fence_all()
+        got_states = [np.asarray(a)
+                      for a in jax.tree.leaves(runner.model_states())]
+        ok = ok and all(
+            np.array_equal(a, b)
+            for a, b in zip(ref_states, got_states)
+        ) and all(
+            np.array_equal(np.asarray(a), b)
+            for a, b in zip(jax.tree.leaves(runner.log),
+                            jax.tree.leaves(ref_log))
+        )
+        # ---- fenced per-round timing on a fresh fleet --------------
+        # (same runner: the verify rounds already compiled + warmed
+        # every program; reset() only re-inits the fleet arrays)
+        runner.reset()
+        runner.round(*batches[0])  # warm from the fresh init
+        runner.fence_all()
+        lat: list[float] = []
+        total = 0.0
+        i = 0
+        while total < duration_s or len(lat) < 3:
+            t0 = time.perf_counter()
+            runner.round(*batches[i % S])
+            runner.fence_all()
+            dt = time.perf_counter() - t0
+            lat.append(dt)
+            total += dt
+            i += 1
+            if len(lat) >= 10_000:  # interpret-mode safety valve
+                break
+        lat.sort()
+        rounds = len(lat)
+        dps = n_replicas * W * rounds / total if total else 0.0
+        points.append(KernelPoint(
+            tier=tier, n_replicas=n_replicas, n_keys=n_keys, window=W,
+            capacity=spec.capacity, rounds=rounds, duration_s=total,
+            dispatches_per_sec=dps,
+            launches_per_round=runner.launches,
+            p50_ms=1e3 * lat[rounds // 2],
+            p95_ms=1e3 * lat[min(rounds - 1, int(rounds * 0.95))],
+            bit_identical=ok, interpret=interpret,
+        ))
+    return points
+
+
+def kernel_rows(name: str, points: list[KernelPoint]) -> list[dict]:
+    """KERNEL_CSV rows for one (R, K, W) point's tier sweep."""
+    return [{
+        "name": f"{name}/{p.tier}",
+        "tier": p.tier,
+        "replicas": p.n_replicas,
+        "keys": p.n_keys,
+        "window": p.window,
+        "capacity": p.capacity,
+        "rounds": p.rounds,
+        "duration": round(p.duration_s, 3),
+        "dispatches_per_sec": round(p.dispatches_per_sec, 1),
+        "launches_per_round": p.launches_per_round,
+        "p50_ms": round(p.p50_ms, 4),
+        "p95_ms": round(p.p95_ms, 4),
+        "bit_identical": int(p.bit_identical),
+        "interpret": int(p.interpret),
+    } for p in points]
+
+
+def append_kernel_csv(out_dir: str, rows: list[dict]) -> None:
+    _append_csv(os.path.join(out_dir, KERNEL_CSV), _KERNEL_FIELDS, rows)
 
 
 @dataclasses.dataclass

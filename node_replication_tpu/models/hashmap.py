@@ -151,6 +151,17 @@ def make_hashmap(n_keys: int, prefill_value: int | None = None) -> Dispatch:
         # THIS state, so the composition is the full per-replica fold
         return window_merge(state, window_plan(state, opcodes, args))
 
+    # fused pallas combiner round (ops/pallas_replay.py): one kernel
+    # launch per serve batch — append + replay + response gather on the
+    # transposed [K, R] planes. Lazily imported so the model stays
+    # importable where pallas is not.
+    def fused_factory(spec, interpret=None):
+        from node_replication_tpu.ops.pallas_replay import (
+            FusedHashmapEngine,
+        )
+
+        return FusedHashmapEngine(n_keys, spec, interpret=interpret)
+
     return Dispatch(
         name=f"hashmap{n_keys}",
         make_state=make_state,
@@ -163,4 +174,5 @@ def make_hashmap(n_keys: int, prefill_value: int | None = None) -> Dispatch:
         # prefix-absorbing plan + canonical responses pinned by
         # tests/test_window.py::test_plan_is_prefix_absorbing
         window_canonical=True,
+        fused_factory=fused_factory,
     )

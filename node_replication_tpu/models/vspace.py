@@ -194,6 +194,19 @@ def make_vspace(n_pages: int, max_span: int = 16) -> Dispatch:
         return window_merge(state, window_plan(state, opcodes, args))
 
     ok_combined = max_span <= n_pages
+
+    # fused pallas combiner round (ops/pallas_vspace.py): the span
+    # kernel with the ring-window append fused in — one launch per
+    # serve batch. The factory rejects configs the span kernel's
+    # row-overlap rule excludes; wrappers then fall back to the chain.
+    def fused_factory(spec, interpret=None):
+        from node_replication_tpu.ops.pallas_vspace import (
+            FusedVspaceEngine,
+        )
+
+        return FusedVspaceEngine(n_pages, max_span, spec,
+                                 interpret=interpret)
+
     return Dispatch(
         name=f"vspace{n_pages}",
         make_state=make_state,
@@ -208,6 +221,7 @@ def make_vspace(n_pages: int, max_span: int = 16) -> Dispatch:
         window_plan=window_plan if ok_combined else None,
         window_merge=window_merge if ok_combined else None,
         window_canonical=ok_combined,
+        fused_factory=fused_factory,
     )
 
 

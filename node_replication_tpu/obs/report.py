@@ -406,6 +406,51 @@ def analyze(events: list[dict]) -> dict:
             "ring_ops": sum(int(e.get("window", 0)) for e in rings),
         }
 
+    # kernels section: fused-round launches by tier, window-size
+    # histogram, per-launch duration percentiles (kernel-launch events,
+    # ops/pallas_*), plus serve batches by combiner engine (the
+    # serve-batch `engine` stamp) and the winner-selection verdicts
+    # (fused-calibration events, core/replica._FusedTier)
+    kernels = None
+    klaunches = [e for e in events if e.get("event") == "kernel-launch"]
+    serve_engines = [e.get("engine") for e in events
+                     if e.get("event") == "serve-batch"
+                     and e.get("engine")]
+    cals = [e for e in events
+            if e.get("event") == "fused-calibration"]
+    if klaunches or serve_engines or cals:
+        launch_by_tier: dict[str, int] = defaultdict(int)
+        window_hist: dict[int, int] = defaultdict(int)
+        kdurs = []
+        for e in klaunches:
+            launch_by_tier[str(e.get("tier", "?"))] += int(
+                e.get("launches", 1)
+            )
+            window_hist[int(e.get("window", 0))] += 1
+            kdurs.append(float(e.get("duration_s", 0.0)))
+        kdurs.sort()
+        batches_by_engine: dict[str, int] = defaultdict(int)
+        for eng in serve_engines:
+            batches_by_engine[str(eng)] += 1
+        kernels = {
+            "rounds": len(klaunches),
+            "launches_by_tier": dict(sorted(launch_by_tier.items())),
+            "window_hist": dict(sorted(window_hist.items())),
+            "fused_ops": sum(int(e.get("count", 0)) for e in klaunches),
+            "launch_p50_s": _percentile(kdurs, 0.50),
+            "launch_p95_s": _percentile(kdurs, 0.95),
+            "serve_batches_by_engine": dict(
+                sorted(batches_by_engine.items())
+            ),
+            "calibrations": [
+                {"winner": e.get("winner", "?"),
+                 "window": int(e.get("window", 0)),
+                 "fused_s": float(e.get("fused_s", 0.0)),
+                 "chain_s": float(e.get("chain_s", 0.0))}
+                for e in cals
+            ],
+        }
+
     return {
         "n_events": len(events),
         "event_counts": dict(counts),
@@ -419,6 +464,7 @@ def analyze(events: list[dict]) -> dict:
         "durability": durability,
         "replication": repl,
         "mesh": mesh,
+        "kernels": kernels,
         "stalls": [
             {"where": where, "log": log, **{k: (sorted(v)
                                                if isinstance(v, set)
@@ -631,6 +677,32 @@ def render(report: dict, out=None) -> None:
         if mesh["ring_execs"]:
             w(f"  ring catch-up: {mesh['ring_execs']} pass(es), "
               f"{mesh['ring_ops']} op(s) rotated over ICI\n")
+
+    kernels = report.get("kernels")
+    if kernels:
+        w("\n== kernels ==\n")
+        lbt = kernels["launches_by_tier"]
+        if lbt:
+            w("  launches by tier: "
+              + "   ".join(f"{k}={v}" for k, v in sorted(lbt.items()))
+              + f"   ({kernels['rounds']} fused round(s), "
+                f"{kernels['fused_ops']} window op(s))\n")
+            w(f"  launch time p50 {_fmt_s(kernels['launch_p50_s'])} "
+              f"p95 {_fmt_s(kernels['launch_p95_s'])}\n")
+        wh = kernels["window_hist"]
+        if wh:
+            w("  window sizes: "
+              + "   ".join(f"{k}x{v}" for k, v in sorted(wh.items()))
+              + "\n")
+        sbe = kernels["serve_batches_by_engine"]
+        if sbe:
+            w("  serve batches by engine: "
+              + "   ".join(f"{k}={v}" for k, v in sorted(sbe.items()))
+              + "\n")
+        for c in kernels["calibrations"]:
+            w(f"  winner selection @ window {c['window']}: "
+              f"{c['winner']} (fused {_fmt_s(c['fused_s'])} vs chain "
+              f"{_fmt_s(c['chain_s'])})\n")
 
     w("\n== stall report ==\n")
     if not report["stalls"]:

@@ -121,6 +121,17 @@ class Dispatch:
     # class docstring). Mere presence of window_plan/window_merge only
     # claims the weaker lock-step contract.
     window_canonical: bool = False
+    # Fused pallas combiner-round engine (optional): a callable
+    # `(spec: LogSpec, interpret=None) -> engine` building the model's
+    # one-kernel-launch append+replay round (e.g.
+    # `ops/pallas_replay.py:FusedHashmapEngine`). The engine contract:
+    # `round(log, states, opcodes, args, count, fenced=None)` under the
+    # lock-step precondition, `supports(window)`, `launches(window)`,
+    # and a `supports_fenced` class flag. Raising ValueError from the
+    # factory means "no fused form at this config" — wrappers fall
+    # back to the append+exec chain (`core/replica.py` winner
+    # selection).
+    fused_factory: Callable | None = None
 
     @property
     def n_write_ops(self) -> int:

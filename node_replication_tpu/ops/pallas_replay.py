@@ -1,9 +1,30 @@
-"""Pallas TPU kernel for the hashmap replay hot loop.
+"""Pallas TPU kernels for the hashmap hot loop: replay, and the FUSED
+append+replay combiner round.
+
+Two contracts live here:
+
+1. **Replay-only** (`make_hashmap_replay` / `make_pallas_step`): the
+   original hand-tiled window replay — the caller appends to the ring
+   separately and hands the kernel the window.
+2. **Fused round** (`FusedHashmapEngine` / `make_fused_hashmap_calls`):
+   a whole combiner round is ONE `pallas_call` — the log-window append
+   (two pre-blended DMA spans over the un-blocked, aliased ring planes,
+   `ops/pallas_ring.py`), the per-entry replay into the transposed
+   `[K, R]` state tiles, the response gather, and the fenced-lane mask
+   (quarantined replicas skip state writeback and report zeroed
+   responses, `fault/health.py`) all happen inside the kernel. The
+   engine is the `log.engine.pallas_fused` tier `NodeReplicated` /
+   `MultiLogReplicated` route `_append_and_replay` rounds through when
+   winner selection picks it (`core/replica.py`), collapsing the
+   host-sequenced encode → `log_append` → sort/merge → replay chain —
+   and its per-round host syncs — into one launch per serve batch.
+   Interpret-mode bit-identity vs the scan engine (ring wrap, fenced,
+   batch, CNR sub-batch paths): tests/test_pallas_fused.py.
 
 The generic replay path (`core/log.log_exec_all`) is a vmapped `lax.scan`
 whose every iteration scatters one element per replica into HBM-resident
-state. This kernel is the hand-tiled alternative for the flagship hashmap
-model (SURVEY.md §7: "Pallas kernels for the append/reserve and
+state. These kernels are the hand-tiled alternative for the flagship
+hashmap model (SURVEY.md §7: "Pallas kernels for the append/reserve and
 scan-replay inner loops if XLA fusion falls short"):
 
 - state is laid out TRANSPOSED, `[K, R]`: keys on the sublane axis,
@@ -47,6 +68,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from node_replication_tpu.core.log import LogSpec, log_append
+from node_replication_tpu.ops.pallas_ring import FusedEngineHost
 from node_replication_tpu.utils.compat import x64_disabled
 
 
@@ -252,3 +274,366 @@ def pallas_hashmap_state(n_keys: int, n_replicas: int):
         "values": jnp.zeros((kp, n_replicas), jnp.int32),
         "present": jnp.zeros((kp, n_replicas), jnp.int32),
     }
+
+
+# ---------------------------------------------------------------------------
+# Fused append+replay engine (one pallas_call per combiner round)
+# ---------------------------------------------------------------------------
+
+
+def _fused_hashmap_kernel(meta_ref, opc_ref, key_ref, val_ref,
+                          app_opc_lo, app_args_lo, app_opc_hi,
+                          app_args_hi, ring_opc_in, ring_args_in,
+                          val_in, pres_in, *rest,
+                          n_keys: int, window: int, win_rows: int,
+                          fenced: bool):
+    """One combiner round: ring-window append (DMA, grid step 0) +
+    in-order replay of the SMEM batch into the `[Kp, tile_r]` state
+    blocks + response gather. `meta = [s_lo, count]`; batch slots at or
+    past `count` are NOOP by the `encode_ops` contract, so the replay
+    loop needs no count gate. With `fenced`, an extra `[1, tile_r]`
+    int32 plane marks quarantined lanes: they replay in VMEM like
+    everyone (keeping the loop branch-free) but their writeback is
+    restored from the input at the end — state and responses of a
+    fenced replica must not move (the caller zeroes their resp rows)."""
+    from node_replication_tpu.ops.pallas_ring import ring_append_dma
+
+    if fenced:
+        (fen_in, ring_opc_out, ring_args_out, val_out, pres_out,
+         resp_ref, sem) = rest
+    else:
+        (ring_opc_out, ring_args_out, val_out, pres_out, resp_ref,
+         sem) = rest
+        fen_in = None
+    # the ring content only flows through the aliasing: the replay
+    # reads the batch from SMEM (append happens-before replay by the
+    # lock-step data dependence, core/log.py)
+    del ring_opc_in, ring_args_in
+    with x64_disabled():
+        @pl.when(pl.program_id(0) == 0)
+        def _append():
+            ring_append_dma(
+                sem, meta_ref[0], win_rows,
+                (app_opc_lo, app_args_lo), (app_opc_hi, app_args_hi),
+                (ring_opc_out, ring_args_out),
+            )
+
+        val_out[:] = val_in[:]
+        pres_out[:] = pres_in[:]
+
+        def body(i, carry):
+            opcode = opc_ref[i]
+            k = jax.lax.rem(key_ref[i], jnp.int32(n_keys))
+            k = jnp.where(k < 0, k + jnp.int32(n_keys), k)
+            v = val_ref[i]
+            is_put = opcode == 1
+            is_rem = opcode == 2
+            row_v = val_out[pl.ds(k, 1), :]
+            row_p = pres_out[pl.ds(k, 1), :]
+            val_out[pl.ds(k, 1), :] = jnp.where(
+                is_put, v, jnp.where(is_rem, 0, row_v)
+            )
+            pres_out[pl.ds(k, 1), :] = jnp.where(
+                is_put, 1, jnp.where(is_rem, 0, row_p)
+            )
+            resp_ref[pl.ds(i, 1), :] = jnp.where(is_rem, row_p, 0)
+            return carry
+
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(window), body,
+                          jnp.int32(0))
+        if fenced:
+            fen = fen_in[0:1, :]
+            val_out[:] = jnp.where(fen > 0, val_in[:], val_out[:])
+            pres_out[:] = jnp.where(fen > 0, pres_in[:], pres_out[:])
+
+
+def make_fused_hashmap_calls(
+    n_keys: int,
+    spec: LogSpec,
+    window: int,
+    tile_r: int = 512,
+    interpret: bool = False,
+    fenced: bool = False,
+):
+    """Build the per-chunk fused `pallas_call`s for one window size.
+
+    Returns `(calls, chunk_r, tile_r)` where `calls[sub]` runs `sub`
+    replica lanes (`sub // tile_r` grid steps, capped at MAX_GRID per
+    call by `pallas_chunk` chunking — the r5 belt-and-braces rule).
+    The ring planes thread through the chunk calls via aliasing, so a
+    multi-chunk round re-issues the (idempotent) append DMA per chunk.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    from node_replication_tpu.ops.pallas_chunk import (
+        build_calls,
+        chunk_size,
+    )
+    from node_replication_tpu.ops.pallas_ring import (
+        fused_window_ok,
+        ring_rows,
+        window_rows,
+    )
+
+    if not fused_window_ok(spec.capacity, window):
+        raise ValueError(
+            f"fused hashmap round: window {window} does not fit the "
+            f"ring-row append spans of capacity {spec.capacity}"
+        )
+    R = spec.n_replicas
+    A = spec.arg_width
+    kp = _round_up(n_keys, 8)
+    win = window_rows(window)
+    rows = ring_rows(spec.capacity)
+    budget = 14 << 20
+    app_bytes = 2 * 4 * (2 * win * 128 * (1 + A))
+
+    def block_bytes(t: int) -> int:
+        # states (values/present x in/out) + the resp block, all
+        # double-buffered by the grid pipeline, plus the append planes
+        return 2 * 4 * (4 * kp * t + window * t) + app_bytes
+
+    candidates = [t for t in (1024, 512, 256, 128)
+                  if R % t == 0] or [R]
+    for t in candidates:
+        if (R % tile_r == 0
+                and (tile_r % 128 == 0 or tile_r == R)
+                and block_bytes(tile_r) <= budget):
+            break
+        tile_r = t
+        if block_bytes(t) <= budget:
+            break
+    if block_bytes(tile_r) > budget and not interpret:
+        raise ValueError(
+            f"fused hashmap round needs {block_bytes(tile_r)} bytes of "
+            f"VMEM at the smallest legal tile ({tile_r} lanes) for "
+            f"n_keys={n_keys}, window={window}; fall back to the "
+            f"append+exec chain for this config"
+        )
+    kernel = functools.partial(
+        _fused_hashmap_kernel, n_keys=n_keys, window=window,
+        win_rows=win, fenced=fenced,
+    )
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    anyspec = lambda: pl.BlockSpec(memory_space=pltpu.ANY)
+
+    def build_call(sub_r: int):
+        state_spec = pl.BlockSpec((kp, tile_r), lambda i: (0, i))
+        in_specs = [
+            smem(),                                   # meta
+            smem(), smem(), smem(),                   # opc/key/val
+            pl.BlockSpec(memory_space=pltpu.VMEM),    # app_opc_lo
+            pl.BlockSpec(memory_space=pltpu.VMEM),    # app_args_lo
+            pl.BlockSpec(memory_space=pltpu.VMEM),    # app_opc_hi
+            pl.BlockSpec(memory_space=pltpu.VMEM),    # app_args_hi
+            anyspec(), anyspec(),                     # ring planes
+            state_spec, state_spec,                   # values/present
+        ]
+        if fenced:
+            in_specs.append(
+                pl.BlockSpec((1, tile_r), lambda i: (0, i))
+            )
+        return pl.pallas_call(
+            kernel,
+            grid=(sub_r // tile_r,),
+            in_specs=in_specs,
+            out_specs=[
+                anyspec(), anyspec(),                 # ring planes out
+                state_spec, state_spec,
+                pl.BlockSpec((window, tile_r), lambda i: (0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((rows, 128), jnp.int32),
+                jax.ShapeDtypeStruct((rows, 128, A), jnp.int32),
+                jax.ShapeDtypeStruct((kp, sub_r), jnp.int32),
+                jax.ShapeDtypeStruct((kp, sub_r), jnp.int32),
+                jax.ShapeDtypeStruct((window, sub_r), jnp.int32),
+            ],
+            # UN-BLOCKED ring planes aliased in->out: outside the grid
+            # pipeline, so exempt from the r5 blocked-plane rule (see
+            # ops/pallas_ring.py and nrlint aliased-pallas-planes)
+            input_output_aliases={8: 0, 9: 1},
+            scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+            interpret=interpret,
+        )
+
+    chunk_r = chunk_size(R, tile_r)
+    return build_calls(R, chunk_r, build_call), chunk_r, tile_r
+
+
+
+class FusedHashmapEngine(FusedEngineHost):
+    """The fused combiner-round engine for the hashmap model.
+
+    `round(log, states, opcodes, args, count, fenced=None)` executes
+    one whole combiner round — append `count` entries at the tail,
+    replay them into every (unfenced) replica, gather responses — as a
+    single jitted program whose device work is ONE kernel launch per
+    replica chunk (usually exactly one). Requires the lock-step
+    precondition the caller checks host-side: every live cursor at the
+    pre-append tail (`core/replica._try_fused_round`).
+
+    States cross the boundary in MODEL layout (`[R, K]` values +
+    bool present, `models/hashmap.py`); the transposes to the kernel's
+    `[Kp, R]` planes live inside the jit. `raw_round` exposes the
+    transposed-resident form for the kernel bench
+    (`harness/mkbench.measure_kernel`), where state stays in kernel
+    layout across rounds — the flagship configuration.
+
+    The tile layout keeps the replica axis as the blocked lane axis in
+    contiguous `tile_r`-wide chunks, i.e. exactly the
+    `P('replica')`-sharded slicing of the PR 9 mesh tier: a per-shard
+    invocation of the chunk calls is the shard-local program
+    (tests/test_pallas_fused.py pins chunk-slice composability). The
+    wrapper currently takes the fused tier only un-meshed; the shmap
+    wiring composes over these same chunks.
+    """
+
+    supports_fenced = True
+
+    def __init__(self, n_keys: int, spec: LogSpec, tile_r: int = 512,
+                 interpret: bool | None = None):
+        from node_replication_tpu.ops.pallas_ring import fused_window_ok
+
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        if not fused_window_ok(spec.capacity, 1):
+            raise ValueError(
+                f"fused hashmap engine: ring capacity {spec.capacity} "
+                f"has no 128-slot row layout"
+            )
+        self.n_keys = int(n_keys)
+        self.spec = spec
+        self.tile_r = int(tile_r)
+        self.interpret = bool(interpret)
+        self.kp = _round_up(self.n_keys, 8)
+        self._calls: dict = {}    # (W, fenced) -> (calls, chunk_r)
+        self._init_host()
+
+    def supports(self, window: int) -> bool:
+        """Window fits the ring-row spans, the appendable capacity,
+        and (non-interpret) the VMEM tile budget."""
+        from node_replication_tpu.ops.pallas_ring import fused_window_ok
+
+        if not fused_window_ok(self.spec.capacity, window):
+            return False
+        if window > self.spec.capacity - self.spec.gc_slack:
+            return False
+        try:
+            self._built(window, False)
+        except ValueError:
+            return False
+        return True
+
+    def launches(self, window: int) -> int:
+        """Kernel launches per round (chunk calls over the replica
+        axis; 1 unless MAX_GRID or VMEM splits the fleet)."""
+        _, chunk_r = self._built(window, False)
+        return -(-self.spec.n_replicas // chunk_r)
+
+    def _built(self, window: int, fenced: bool):
+        key = (window, fenced)
+        if key not in self._calls:
+            calls, chunk_r, _ = make_fused_hashmap_calls(
+                self.n_keys, self.spec, window, tile_r=self.tile_r,
+                interpret=self.interpret, fenced=fenced,
+            )
+            self._calls[key] = (calls, chunk_r)
+        return self._calls[key]
+
+    def raw_round(self, window: int, fenced: bool = False):
+        """Pure fn over TRANSPOSED planes: `(log, vals_t, pres_t,
+        opcodes, args, count[, fenced_vec]) -> (log, vals_t, pres_t,
+        resps[W, R])`. Composable inside a caller's jit (the CNR
+        per-log wrapper, the kernel bench)."""
+        from node_replication_tpu.ops.pallas_ring import (
+            append_window_planes,
+            fused_cursor_lattice,
+            ring_rows,
+        )
+
+        calls, chunk_r = self._built(window, fenced)
+        spec = self.spec
+        R, A = spec.n_replicas, spec.arg_width
+        rows = ring_rows(spec.capacity)
+
+        def raw(log, vals_t, pres_t, opcodes, args, count,
+                fenced_vec=None):
+            ring_opc = log.opcodes.reshape(rows, 128)
+            ring_args = log.args.reshape(rows, 128, A)
+            s_lo, planes = append_window_planes(
+                spec.mask, ring_opc, ring_args, opcodes, args,
+                log.tail, count,
+            )
+            meta = jnp.stack(
+                [s_lo, jnp.asarray(count, jnp.int32)]
+            )
+            key = args[:, 0]
+            val = args[:, 1]
+            fen_plane = (
+                None if fenced_vec is None
+                else jnp.asarray(fenced_vec, jnp.int32).reshape(1, R)
+            )
+            v_chunks, p_chunks, r_chunks = [], [], []
+            with x64_disabled():
+                for r0 in range(0, R, chunk_r):
+                    sub = min(chunk_r, R - r0)
+                    ins = [meta, opcodes, key, val, *planes,
+                           ring_opc, ring_args,
+                           vals_t[:, r0:r0 + sub],
+                           pres_t[:, r0:r0 + sub]]
+                    if fen_plane is not None:
+                        ins.append(fen_plane[:, r0:r0 + sub])
+                    (ring_opc, ring_args, v, p, r) = calls[sub](*ins)
+                    v_chunks.append(v)
+                    p_chunks.append(p)
+                    r_chunks.append(r)
+            cat = (
+                lambda xs: xs[0] if len(xs) == 1
+                else jnp.concatenate(xs, axis=1)
+            )
+            vals_t, pres_t = cat(v_chunks), cat(p_chunks)
+            resps = cat(r_chunks)
+            log = log._replace(
+                opcodes=ring_opc.reshape(spec.capacity),
+                args=ring_args.reshape(spec.capacity, A),
+            )
+            log = fused_cursor_lattice(log, count, fenced_vec)
+            return log, vals_t, pres_t, resps
+
+        return raw
+
+    def round_fn(self, window: int, fenced: bool = False):
+        """Pure MODEL-layout round fn (transposes inside): `(log,
+        states, opcodes, args, count[, fenced_vec]) -> (log, states,
+        resps[R, W])` with `resps[r, j]` answering window offset j
+        (= logical position tail+j under lock-step) and fenced rows
+        zeroed — the layout response delivery consumes."""
+        raw = self.raw_round(window, fenced)
+        K, kp = self.n_keys, self.kp
+
+        def fn(log, states, opcodes, args, count, fenced_vec=None):
+            vals_t = jnp.zeros(
+                (kp, states["values"].shape[0]), jnp.int32
+            ).at[:K].set(states["values"].T)
+            pres_t = jnp.zeros_like(vals_t).at[:K].set(
+                states["present"].T.astype(jnp.int32)
+            )
+            log, vals_t, pres_t, resps = raw(
+                log, vals_t, pres_t, opcodes, args, count, fenced_vec
+            )
+            states = {
+                "values": vals_t[:K].T,
+                "present": pres_t[:K].T > 0,
+            }
+            resps = resps.T  # [R, W]
+            if fenced_vec is not None:
+                resps = jnp.where(
+                    jnp.asarray(fenced_vec, bool)[:, None], 0, resps
+                )
+            return log, states, resps
+
+        return fn
+
+    # round() — the host entry with metrics + the kernel-launch event —
+    # is inherited from FusedEngineHost (ops/pallas_ring.py)

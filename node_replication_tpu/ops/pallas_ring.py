@@ -1,0 +1,278 @@
+"""Ring-window machinery shared by the FUSED append+replay kernels.
+
+The fused engines (`ops/pallas_replay.py:FusedHashmapEngine`,
+`ops/pallas_vspace.py:FusedVspaceEngine`) run a whole combiner round —
+log-window append, replay, response gather — as ONE `pallas_call`. The
+append half is the part they share, and it lives here.
+
+Layout contract: the log's ring arrays enter the kernel UN-BLOCKED
+(`memory_space=pltpu.ANY`, aliased in→out), viewed 2-D as
+`[capacity/128, 128]` — ring rows of 128 slots each, a free row-major
+reshape of the canonical `LogState` planes. The appended window
+`[tail, tail+count)` covers at most `window_rows(W)` consecutive rows
+(mod ring wrap), so the kernel updates the ring with TWO fixed-size
+async copies of pre-blended row spans:
+
+- the **lo span**: `win_rows` rows starting at the (dynamic, clamped)
+  row of the tail slot,
+- the **hi span**: rows `[0, win_rows)` — the wrap landing zone.
+
+`append_window_planes` builds both spans XLA-side in O(window) work: it
+gathers the spans' current content, blends the batch over exactly the
+slots `[tail, tail+count)` (delta-mod arithmetic handles the wrap), and
+leaves every other covered slot bit-identical — so DMA-ing a span back
+rewrites untouched slots with their own values. When the window does
+not wrap, the hi span degenerates to an identity rewrite of the first
+rows. Both spans may overlap on small rings; they carry identical
+content, and the kernel issues them sequentially.
+
+Why DMA instead of per-entry stores: Mosaic has no dynamic LANE
+indexing, and a ring row puts the slot index on the lane axis. The
+pre-blended spans turn the scatter into two aligned block copies — the
+double-buffered-VMEM-window idiom over the ring — while the un-blocked
+ANY refs keep the aliasing OUTSIDE the grid pipeline, which is exactly
+the regime the r5 corruption rule (`ops/pallas_chunk.py`, nrlint
+`aliased-pallas-planes`) says is safe: only BLOCKED planes race the
+pipeline's prefetch/writeback.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+RING_LANES = 128
+
+
+def window_rows(window: int) -> int:
+    """Ring rows covering any 128-phase alignment of `window` slots."""
+    return -(-window // RING_LANES) + 1
+
+
+def ring_rows(capacity: int) -> int:
+    return capacity // RING_LANES
+
+
+def fused_window_ok(capacity: int, window: int) -> bool:
+    """Can a `window`-slot append ride the two fixed row spans?
+
+    Needs the ring to be row-shaped (capacity a multiple of 128 — every
+    power of two >= 128 qualifies) and tall enough that a span of
+    `window_rows(window)` rows fits; `window + 128 <= capacity` keeps
+    the lo span's clamp (`min(r0, rows - win_rows)`) able to cover the
+    tail row. Callers fall back to the ordinary append+exec chain when
+    this is False.
+    """
+    if capacity % RING_LANES or window < 1:
+        return False
+    return (
+        window_rows(window) <= ring_rows(capacity)
+        and window + RING_LANES <= capacity
+    )
+
+
+def append_window_planes(mask: int, ring_opc2d, ring_args3d,
+                         opcodes, args, tail, count):
+    """XLA-side prep: desired POST-append content of the two row spans.
+
+    `ring_opc2d`/`ring_args3d` are the `[rows, 128]` / `[rows, 128, A]`
+    views of the ring planes, `opcodes`/`args` the NOOP-padded batch
+    (`[W]` / `[W, A]`), `tail` the int64 append cursor and `count` the
+    number of live entries (`count <= W`). Returns
+    `(s_lo, (opc_lo, args_lo, opc_hi, args_hi))` with `s_lo` the lo
+    span's starting row (int32) and each plane shaped
+    `[win_rows, 128(, A)]` — ready to DMA over the ring rows.
+    """
+    W = opcodes.shape[0]
+    win = window_rows(W)
+    rows = (mask + 1) // RING_LANES
+    tail_slot = (tail & mask).astype(jnp.int32)
+    r0 = tail_slot // RING_LANES
+    s_lo = jnp.minimum(r0, jnp.int32(rows - win))
+    count32 = jnp.asarray(count, jnp.int32)
+
+    def span(row0):
+        s = row0 * RING_LANES + jnp.arange(
+            win * RING_LANES, dtype=jnp.int32
+        )
+        # slot-space delta from the tail: in [0, capacity); slots whose
+        # delta lands below `count` are the appended entries
+        d = (s - tail_slot) & jnp.int32(mask)
+        live = d < count32
+        gi = jnp.clip(d, 0, W - 1)
+        old_opc = lax.dynamic_slice(
+            ring_opc2d, (row0, jnp.int32(0)), (win, RING_LANES)
+        ).reshape(win * RING_LANES)
+        old_args = lax.dynamic_slice(
+            ring_args3d, (row0, jnp.int32(0), jnp.int32(0)),
+            (win, RING_LANES, ring_args3d.shape[2]),
+        ).reshape(win * RING_LANES, ring_args3d.shape[2])
+        opc = jnp.where(live, opcodes[gi], old_opc)
+        arg = jnp.where(live[:, None], args[gi], old_args)
+        return (
+            opc.reshape(win, RING_LANES),
+            arg.reshape(win, RING_LANES, ring_args3d.shape[2]),
+        )
+
+    opc_lo, args_lo = span(s_lo)
+    opc_hi, args_hi = span(jnp.int32(0))
+    return s_lo, (opc_lo, args_lo, opc_hi, args_hi)
+
+
+def ring_append_dma(sem, s_lo, win_rows: int, lo_planes, hi_planes,
+                    ring_outs):
+    """Kernel-side append: copy the pre-blended spans over the ring.
+
+    `lo_planes`/`hi_planes` are VMEM refs of the planes built by
+    `append_window_planes`; `ring_outs` the matching UN-BLOCKED
+    (aliased) ring output refs, 2-D/3-D row views. Copies run
+    sequentially — the spans may overlap on small rings, and they carry
+    identical content for shared rows, so ordering only matters for
+    write-write tearing, which the serialization removes.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    for src, dst in zip(lo_planes, ring_outs):
+        cp = pltpu.make_async_copy(
+            src, dst.at[pl.ds(s_lo, win_rows)], sem
+        )
+        cp.start()
+        cp.wait()
+    for src, dst in zip(hi_planes, ring_outs):
+        cp = pltpu.make_async_copy(
+            src, dst.at[pl.ds(0, win_rows)], sem
+        )
+        cp.start()
+        cp.wait()
+
+
+class FusedEngineHost:
+    """Shared host-side plumbing for the fused engines
+    (`ops/pallas_replay.FusedHashmapEngine`,
+    `ops/pallas_vspace.FusedVspaceEngine`): the per-window round cache
+    (jit on TPU, EAGER in interpret mode — jit + interpret + the
+    package's x64 default trips an MLIR where-fn dtype mismatch in
+    this jax, the same reason every interpret test passes jit=False),
+    the `kernel.*` metrics, the `log.engine.pallas_fused` tier counter,
+    and the `kernel-launch` trace event. Subclasses provide
+    `round_fn(window, fenced)`, `launches(window)`, `supports(window)`,
+    a `supports_fenced` class flag, and set `self.interpret`.
+
+    `note_round` is public so callers that embed `round_fn` in their
+    own program (the CNR per-log wrapper) report the same metrics as
+    callers of `round()` — one instrumentation contract, never two.
+    """
+
+    supports_fenced = False
+
+    def _init_host(self) -> None:
+        from node_replication_tpu.obs.metrics import (
+            COUNT_BUCKETS,
+            get_registry,
+        )
+
+        reg = get_registry()
+        self._m_launches = reg.counter("kernel.launches")
+        self._m_ops = reg.counter("kernel.fused_window_ops")
+        self._m_window = reg.histogram("kernel.window",
+                                       buckets=COUNT_BUCKETS)
+        self._m_dur = reg.histogram("kernel.round.duration_s")
+        self._rounds: dict = {}
+
+    def note_round(self, window: int, count: int, duration_s: float,
+                   fenced: bool = False) -> None:
+        """Count one fused round: tier counter, kernel.* metrics,
+        kernel-launch event. Duration is enqueue-side (the tunneled
+        platform returns at dispatch); fenced timing is the caller's
+        span contract."""
+        from node_replication_tpu.core import log as _corelog
+        from node_replication_tpu.utils.trace import get_tracer
+
+        n_launch = self.launches(window)
+        # nrlint: disable=obs-in-traced — host side of the jit boundary
+        _corelog._m_engine_pallas_fused.inc()
+        self._m_launches.inc(n_launch)
+        self._m_ops.inc(int(count))
+        self._m_window.observe(window)
+        self._m_dur.observe(duration_s)
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.emit(
+                "kernel-launch", tier="pallas_fused", window=window,
+                count=int(count), launches=n_launch,
+                duration_s=duration_s, fenced=fenced,
+            )
+
+    def round(self, log, states, opcodes, args, count, fenced=None):
+        """Host entry: cached model-layout round + instrumentation.
+        `count` is a host int; `opcodes` must be NOOP-padded past it
+        (`encode_ops`)."""
+        import time as _time
+
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        if fenced is not None and not self.supports_fenced:
+            raise ValueError(
+                f"{type(self).__name__} has no fenced kernel variant "
+                f"(supports_fenced=False)"
+            )
+        W = int(opcodes.shape[0])
+        is_fenced = fenced is not None
+        fn = self._rounds.get((W, is_fenced))
+        if fn is None:
+            inner = self.round_fn(W, is_fenced)
+            fn = (
+                inner if self.interpret
+                else _jax.jit(inner, donate_argnums=(0, 1))
+            )
+            self._rounds[(W, is_fenced)] = fn
+        t0 = _time.perf_counter()
+        if is_fenced:
+            out = fn(log, states, opcodes, args, count,
+                     _jnp.asarray(fenced, bool))
+        else:
+            out = fn(log, states, opcodes, args, count)
+        self.note_round(W, count, _time.perf_counter() - t0,
+                        fenced=is_fenced)
+        return out
+
+
+def fused_cursor_lattice(log, count, fenced=None):
+    """The fused round's cursor join — the same lattice `log_exec_all`
+    computes, specialized to the lock-step precondition (every live
+    cursor at the pre-append tail, the whole window consumed):
+
+    - `tail += count`;
+    - unfenced `ltails` land on the new tail, fenced cursors freeze;
+    - `ctail = max(ctail, max(ltails))` (= the new tail, since the
+      eligibility check guarantees a live replica);
+    - `head` = the `_gc_head` reduction (min over unfenced, clamped
+      monotone), so a fenced corpse neither stalls GC nor rewinds it.
+    """
+    from node_replication_tpu.core.log import _gc_head
+
+    new_tail = log.tail + jnp.asarray(count, jnp.int64)
+    R = log.ltails.shape[0]
+    if fenced is None:
+        new_lt = jnp.broadcast_to(new_tail, (R,))
+        # ctail/head written as their true lattice joins (both reduce
+        # to the new tail here) rather than re-using `new_tail`: three
+        # cursor outputs sharing ONE buffer would make the next
+        # donating program reject the log ("donate the same buffer
+        # twice")
+        return log._replace(
+            tail=new_tail,
+            ltails=new_lt,
+            ctail=jnp.maximum(log.ctail, new_tail),
+            head=jnp.maximum(log.head, jnp.min(new_lt)),
+        )
+    fen = jnp.asarray(fenced, bool)
+    new_lt = jnp.where(fen, log.ltails, new_tail)
+    out = log._replace(
+        tail=new_tail,
+        ltails=new_lt,
+        ctail=jnp.maximum(log.ctail, jnp.max(new_lt)),
+    )
+    return out._replace(head=_gc_head(out, new_lt, fen))

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from node_replication_tpu.core.log import LogSpec, log_append
+from node_replication_tpu.ops.pallas_ring import FusedEngineHost
 from node_replication_tpu.utils.compat import x64_disabled
 
 _FRAME_MASK = (1 << 30) - 1
@@ -894,6 +895,230 @@ def _vspace_reads(n_pages: int, max_span: int, radix: bool):
         return jnp.where(rd_opcodes == 3, tables, out)
 
     return reads
+
+
+# ------------------------------------------------- fused combiner round
+def _fused_flat_kernel(meta_ref, opc_ref, a0_ref, a1_ref, a2_ref,
+                       app_opc_lo, app_args_lo, app_opc_hi, app_args_hi,
+                       ring_opc_in, ring_args_in, fr_in,
+                       ring_opc_out, ring_args_out, fr_out, resp_ref,
+                       sem, *, n_pages: int, max_span: int, window: int,
+                       rows: int, span_rows: int, win_rows: int):
+    """Fused flat-vspace combiner round: the span-machinery replay body
+    (`_flat_body` — unchanged, so the replay semantics cannot drift
+    from the replay-only kernel) prefixed with the ring-window append
+    DMA (`ops/pallas_ring.py`). One launch appends the batch to the
+    ring AND replays it into every replica group."""
+    from node_replication_tpu.ops.pallas_ring import ring_append_dma
+
+    del ring_opc_in, ring_args_in  # content flows via the aliasing
+    with x64_disabled():
+        @pl.when(pl.program_id(0) == 0)
+        def _append():
+            ring_append_dma(
+                sem, meta_ref[0], win_rows,
+                (app_opc_lo, app_args_lo), (app_opc_hi, app_args_hi),
+                (ring_opc_out, ring_args_out),
+            )
+
+        _flat_body(opc_ref, a0_ref, a1_ref, a2_ref, fr_in, fr_out,
+                   resp_ref, n_pages, max_span, window, rows,
+                   span_rows, copy_in=True)
+
+
+
+class FusedVspaceEngine(FusedEngineHost):
+    """Fused append+replay engine for the FLAT vspace model — the
+    span-machinery twin of `ops/pallas_replay.FusedHashmapEngine` (same
+    engine contract, same `core/replica.py` tier routing). Page-table
+    state crosses the boundary in MODEL layout (`frames: int32[R, P]`);
+    the `[R, ROWS, 128]` grid padding lives inside the round. Responses
+    are the kernel's canonical copy broadcast per replica — sound under
+    the same lock-step precondition the tier's eligibility check
+    enforces. No fenced variant: the span kernel's group layout lets
+    replica 0 speak for its group, which a frozen corrupt lane would
+    poison — fenced fleets fall back to the chain
+    (`supports_fenced=False`). The radix model keeps the replay-only
+    kernels (its level tables ride registers; a fused variant is a
+    follow-up)."""
+
+    supports_fenced = False
+
+    def __init__(self, n_pages: int, max_span: int, spec,
+                 interpret: bool | None = None):
+        import jax as _jax
+
+        from node_replication_tpu.ops.pallas_ring import fused_window_ok
+
+        if interpret is None:
+            interpret = _jax.default_backend() != "tpu"
+        rows, group = _grid_layout(n_pages, spec.n_replicas, interpret,
+                                   "fused flat vspace")
+        span_rows = min(-(-max_span // 128) + 1, rows)
+        if n_pages < span_rows * 128 + max_span:
+            raise ValueError(
+                f"fused flat vspace needs n_pages >= "
+                f"{span_rows * 128 + max_span} (mod-wrapped span row "
+                f"non-overlap); got {n_pages}"
+            )
+        if not fused_window_ok(spec.capacity, 1):
+            raise ValueError(
+                f"fused vspace engine: ring capacity {spec.capacity} "
+                f"has no 128-slot row layout"
+            )
+        self.n_pages = int(n_pages)
+        self.max_span = int(max_span)
+        self.spec = spec
+        self.interpret = bool(interpret)
+        self._rows = rows
+        self._group = group
+        self._calls: dict = {}
+        self._init_host()
+
+    def supports(self, window: int) -> bool:
+        from node_replication_tpu.ops.pallas_ring import fused_window_ok
+
+        # 4096-entry SMEM window bound: the replay-only step chunks
+        # past it; the fused round keeps one launch and falls back
+        return (
+            window <= 4096
+            and fused_window_ok(self.spec.capacity, window)
+            and window <= self.spec.capacity - self.spec.gc_slack
+        )
+
+    def launches(self, window: int) -> int:
+        from node_replication_tpu.ops.pallas_chunk import chunk_size
+
+        return -(-self.spec.n_replicas
+                 // chunk_size(self.spec.n_replicas, self._group))
+
+    def _built(self, window: int):
+        calls = self._calls.get(window)
+        if calls is None:
+            calls = self._build_calls(window)
+            self._calls[window] = calls
+        return calls
+
+    def _build_calls(self, window: int):
+        from jax.experimental.pallas import tpu as pltpu
+
+        from node_replication_tpu.ops.pallas_chunk import (
+            build_calls,
+            chunk_size,
+        )
+        from node_replication_tpu.ops.pallas_ring import (
+            ring_rows,
+            window_rows,
+        )
+
+        spec = self.spec
+        rows, group = self._rows, self._group
+        span_rows = min(-(-self.max_span // 128) + 1, rows)
+        win = window_rows(window)
+        nrr = ring_rows(spec.capacity)
+        A = spec.arg_width
+        kernel = functools.partial(
+            _fused_flat_kernel, n_pages=self.n_pages,
+            max_span=self.max_span, window=window, rows=rows,
+            span_rows=span_rows, win_rows=win,
+        )
+        smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+        anyspec = lambda: pl.BlockSpec(memory_space=pltpu.ANY)
+        vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
+        shared = pl.BlockSpec((1, 1, window), lambda i: (0, 0, 0),
+                              memory_space=pltpu.SMEM)
+
+        def build_call(sub_r: int):
+            state_spec = pl.BlockSpec((group, rows, 128),
+                                      lambda i: (i, 0, 0))
+            return pl.pallas_call(
+                kernel,
+                grid=(sub_r // group,),
+                in_specs=[
+                    smem(),                       # meta
+                    smem(), smem(), smem(), smem(),  # opc/a0/a1/a2
+                    vmem(), vmem(), vmem(), vmem(),  # append planes
+                    anyspec(), anyspec(),            # ring planes
+                    state_spec,
+                ],
+                out_specs=[anyspec(), anyspec(), state_spec, shared],
+                out_shape=[
+                    jax.ShapeDtypeStruct((nrr, 128), jnp.int32),
+                    jax.ShapeDtypeStruct((nrr, 128, A), jnp.int32),
+                    jax.ShapeDtypeStruct((sub_r, rows, 128), jnp.int32),
+                    jax.ShapeDtypeStruct((1, 1, window), jnp.int32),
+                ],
+                # UN-BLOCKED ring planes aliased in->out (outside the
+                # grid pipeline — the r5-safe aliasing regime)
+                input_output_aliases={9: 0, 10: 1},
+                scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+                interpret=self.interpret,
+            )
+
+        chunk_r = chunk_size(spec.n_replicas, group)
+        return build_calls(spec.n_replicas, chunk_r, build_call), chunk_r
+
+    def round_fn(self, window: int, fenced: bool = False):
+        from node_replication_tpu.ops.pallas_ring import (
+            append_window_planes,
+            fused_cursor_lattice,
+            ring_rows,
+        )
+
+        if fenced:
+            raise ValueError(
+                "fused vspace round has no fenced variant "
+                "(supports_fenced=False)"
+            )
+        calls, chunk_r = self._built(window)
+        spec = self.spec
+        R, A, P = spec.n_replicas, spec.arg_width, self.n_pages
+        rows = self._rows
+        nrr = ring_rows(spec.capacity)
+
+        def fn(log, states, opcodes, args, count, fenced_vec=None):
+            ring_opc = log.opcodes.reshape(nrr, 128)
+            ring_args = log.args.reshape(nrr, 128, A)
+            s_lo, planes = append_window_planes(
+                spec.mask, ring_opc, ring_args, opcodes, args,
+                log.tail, count,
+            )
+            meta = jnp.stack([s_lo, jnp.asarray(count, jnp.int32)])
+            fr = jnp.zeros((R, rows * 128), jnp.int32).at[:, :P].set(
+                states["frames"]
+            ).reshape(R, rows, 128)
+            a0, a1, a2 = args[:, 0], args[:, 1], args[:, 2]
+            fr_chunks = []
+            resp = None
+            with x64_disabled():
+                for r0 in range(0, R, chunk_r):
+                    sub = min(chunk_r, R - r0)
+                    ring_opc, ring_args, f, resp = calls[sub](
+                        meta, opcodes, a0, a1, a2, *planes,
+                        ring_opc, ring_args, fr[r0:r0 + sub],
+                    )
+                    fr_chunks.append(f)
+            fr = (
+                fr_chunks[0] if len(fr_chunks) == 1
+                else jnp.concatenate(fr_chunks, axis=0)
+            )
+            log = log._replace(
+                opcodes=ring_opc.reshape(spec.capacity),
+                args=ring_args.reshape(spec.capacity, A),
+            )
+            log = fused_cursor_lattice(log, count, None)
+            states = {"frames": fr.reshape(R, -1)[:, :P]}
+            # canonical responses, shared by the lock-step fleet
+            resps = jnp.broadcast_to(
+                resp.reshape(window)[None], (R, window)
+            )
+            return log, states, resps
+
+        return fn
+
+    # round() — the host entry with metrics + the kernel-launch event —
+    # is inherited from FusedEngineHost (ops/pallas_ring.py); the
+    # fenced-mask rejection falls out of supports_fenced=False there
 
 
 def make_pallas_vspace_step(

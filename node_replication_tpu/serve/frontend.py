@@ -1308,7 +1308,17 @@ class ServeFrontend:
         self._depth_gauges[rid].set(depth)
         tracer = get_tracer()
         if tracer.enabled:
+            # which combiner-round engine served this batch
+            # (pallas_fused / combined / scan — obs/report's Kernels
+            # section consumes). Per-rid lookup: this worker is the
+            # only round-driver for its replica, so the stamp cannot
+            # be overwritten by a concurrent worker's round the way a
+            # wrapper-wide field would be.
+            tier_of = getattr(self._nr, "round_tier", None)
             tracer.emit(
                 "serve-batch", rid=rid, n=len(live), expired=missed,
                 queue_depth=depth, duration_s=dur,
+                engine=(tier_of(rid) if tier_of is not None
+                        else getattr(self._nr, "last_round_tier",
+                                     None)),
             )

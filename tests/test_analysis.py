@@ -1056,3 +1056,112 @@ class TestHostTransferInShardedPath:
                 return np.asarray(self.states)
         """)
         assert not firing(diags, "host-transfer-in-sharded-path")
+
+
+class TestAliasedPallasPlanes:
+    def _lint_in_ops(self, tmp_path, source):
+        import textwrap
+        d = tmp_path / "ops"
+        d.mkdir(parents=True, exist_ok=True)
+        p = d / "snippet.py"
+        p.write_text(textwrap.dedent(source))
+        diags, errors = run_lint([str(p)])
+        assert not errors, errors
+        return diags
+
+    def test_aliased_blocked_plane_on_deep_grid_fires(self, tmp_path):
+        # the exact r5 corruption shape: a blocked state plane aliased
+        # in->out while the grid pipelines across replica tiles
+        diags = self._lint_in_ops(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def build(kernel, kp, tile, R, shape):
+                return pl.pallas_call(
+                    kernel,
+                    grid=(R // tile,),
+                    in_specs=[pl.BlockSpec((kp, tile), lambda i: (0, i))],
+                    out_specs=[pl.BlockSpec((kp, tile), lambda i: (0, i))],
+                    out_shape=shape,
+                    input_output_aliases={0: 0},
+                )
+        """)
+        assert len(firing(diags, "aliased-pallas-planes")) == 1
+
+    def test_grid_one_plan_kernel_aliasing_clean(self, tmp_path):
+        # the plan kernels' sanctioned in-place form: one grid step,
+        # no pipeline to race (ops/pallas_vspace.py)
+        diags = self._lint_in_ops(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def build(kernel, rows, shape):
+                grid = (1,)
+                plane = pl.BlockSpec((1, rows, 128), lambda i: (0, 0, 0))
+                return pl.pallas_call(
+                    kernel,
+                    grid=grid,
+                    in_specs=[plane, plane],
+                    out_specs=[plane, plane],
+                    out_shape=shape,
+                    input_output_aliases={0: 0, 1: 1},
+                )
+        """)
+        assert not firing(diags, "aliased-pallas-planes")
+
+    def test_unblocked_any_ref_dma_aliasing_clean(self, tmp_path):
+        # the fused round's ring planes: memory_space-only specs moved
+        # by explicit in-kernel DMA sit outside the grid pipeline
+        diags = self._lint_in_ops(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def build(kernel, kp, tile, R, shape):
+                return pl.pallas_call(
+                    kernel,
+                    grid=(R // tile,),
+                    in_specs=[
+                        pl.BlockSpec(memory_space=pltpu.ANY),
+                        pl.BlockSpec((kp, tile), lambda i: (0, i)),
+                    ],
+                    out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                    out_shape=shape,
+                    input_output_aliases={0: 0},
+                )
+        """)
+        assert not firing(diags, "aliased-pallas-planes")
+
+    def test_outside_ops_and_unaliased_clean(self, tmp_path):
+        # path scope: kernels live in ops/; an aliased call elsewhere
+        # (scratch experiments, tests) is out of scope — and a deep
+        # grid WITHOUT aliasing is the sanctioned separate-plane shape
+        diags = lint_src(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def build(kernel, kp, tile, R, shape):
+                return pl.pallas_call(
+                    kernel,
+                    grid=(R // tile,),
+                    in_specs=[pl.BlockSpec((kp, tile), lambda i: (0, i))],
+                    out_specs=[pl.BlockSpec((kp, tile), lambda i: (0, i))],
+                    out_shape=shape,
+                    input_output_aliases={0: 0},
+                )
+        """)
+        assert not firing(diags, "aliased-pallas-planes")
+        diags2 = self._lint_in_ops(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def build(kernel, kp, tile, R, shape):
+                return pl.pallas_call(
+                    kernel,
+                    grid=(R // tile,),
+                    in_specs=[pl.BlockSpec((kp, tile), lambda i: (0, i))],
+                    out_specs=[pl.BlockSpec((kp, tile), lambda i: (0, i))],
+                    out_shape=shape,
+                )
+        """)
+        assert not firing(diags2, "aliased-pallas-planes")
