@@ -1438,7 +1438,31 @@ def follower_primary_main(args) -> int:
         durability=args.crash_durability,
     )
     fe = ServeFrontend(nr, cfg)
-    fe.ack_barrier = shipper.barrier  # ship-before-ack
+    if args.tree_port_file:
+        # --tree mode: serve the feed (and snapshots) over TCP and
+        # gate acks on downstream receipt too — an ack then implies
+        # fsynced AND feed-visible AND received by every direct relay,
+        # which is exactly what makes a mid-tree promotion lossless
+        # after this process is SIGKILLed (the relays are all a
+        # promoted follower can still reach)
+        from node_replication_tpu.durable.wal import durable_publish
+        from node_replication_tpu.repl import (
+            FeedServer,
+            make_tree_barrier,
+        )
+
+        server = FeedServer(feed, snapshot_dir=d, wal=wal)
+        fe.ack_barrier = make_tree_barrier(
+            shipper, server,
+            min_clients=max(1, args.tree_min_downstream),
+            timeout=60.0,
+        )
+        durable_publish(
+            args.tree_port_file,
+            f"{server.address[0]} {server.address[1]}".encode(),
+        )
+    else:
+        fe.ack_barrier = shipper.barrier  # ship-before-ack
     rids = fe.rids
     ack_lock = threading.Lock()
     ack_f = open(os.path.join(d, "acks.log"), "a")
@@ -1843,6 +1867,562 @@ def follower_main(args) -> int:
     return 0
 
 
+def tree_follower_main(args) -> int:
+    """`--tree-follower` (internal): one LEAF follower process of the
+    `--tree` harness. Connects to its assigned relay over TCP, catches
+    up to `--tree-target` (bootstrapping from a shipped snapshot when
+    the tree holds one and `--tree-bootstrap` allows), signals
+    readiness, waits for the parent's go-file barrier, then serves
+    local reads flat-out for `--tree-read-seconds` and writes a JSON
+    result file. A separate PROCESS per follower, so the aggregate
+    read-throughput claim is measured GIL-free — the way a real
+    deployment's followers scale."""
+    import os
+
+    from node_replication_tpu.durable.wal import durable_publish
+    from node_replication_tpu.models import SR_GET, make_seqreg
+    from node_replication_tpu.repl import Follower, SocketFeed
+
+    clients = args.serve_clients
+    host, port = args.tree_connect.split(":")
+    dispatch = make_seqreg(clients)
+    feed = SocketFeed(host, int(port), arg_width=dispatch.arg_width)
+    f = Follower(
+        dispatch, feed, args.crash_dir,
+        nr_kwargs=dict(n_replicas=1, log_entries=1 << 15,
+                       gc_slack=512, exec_window=256),
+        poll_s=0.002, bootstrap=bool(args.tree_bootstrap),
+        name=os.path.basename(args.crash_dir),
+    )
+    caught_up = f.wait_applied(args.tree_target,
+                               timeout=args.tree_timeout)
+    durable_publish(args.tree_ready_file, b"ready")
+    t_wait = time.monotonic() + args.tree_timeout
+    while not os.path.exists(args.tree_go_file):
+        if time.monotonic() > t_wait:
+            break
+        time.sleep(0.005)
+    reads = 0
+    t0 = time.monotonic()
+    t_end = t0 + args.tree_read_seconds
+    while time.monotonic() < t_end:
+        f.frontend.read((SR_GET, reads % clients), rid=0)
+        reads += 1
+    elapsed = time.monotonic() - t0
+    durable_publish(args.tree_result_file, json.dumps({
+        "reads": reads,
+        "seconds": elapsed,
+        "caught_up": bool(caught_up),
+        "applied": f.applied_pos(),
+        "bootstrap_pos": (
+            f.bootstrap_report[0] if f.bootstrap_report else 0
+        ),
+    }).encode())
+    f.close()
+    return 0
+
+
+def tree_main(args) -> int:
+    """`--tree`: the multi-host replication-tree gate (ISSUE 12).
+
+    Forks a primary whose acks are fsynced + shipped + CONFIRMED
+    RECEIVED by every relay (`make_tree_barrier`), builds a
+    primary → `--tree-relays` relays → `--tree-followers` leaf
+    topology over localhost TCP, and verifies, with hard exits:
+
+    - **read scale-out**: aggregate leaf read throughput (each leaf
+      its own process — GIL-free) must exceed a single leaf's by
+      `--tree-scaling-min`, while the primary's ack rate holds within
+      `--tree-primary-hold` of its single-leaf rate;
+    - **snapshot bootstrap**: a cold follower bootstrapping from the
+      shipped `snap-<pos>.npz` must catch up strictly faster than an
+      identical follower replaying the full history;
+    - **mid-tree failover**: SIGKILL of the primary is detected
+      through the relay's forwarded heartbeat, the candidate follower
+      promotes (fence forwarded over the socket into the relay's
+      journal), and every acked `(client, i)` is present exactly once
+      — zero lost, zero duplicated — with the measured RTO reported;
+    - **zombie fencing over the wire**: a record stamped with the
+      dead primary's epoch, injected into the primary's feed, is
+      dropped by the fenced relay and never reaches the subtree.
+    """
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from node_replication_tpu.harness.mkbench import (
+        append_tree_csv,
+        tree_rows,
+    )
+    from node_replication_tpu.models import SR_GET, SR_SET, make_seqreg
+    from node_replication_tpu.repl import (
+        DirectoryFeed,
+        Follower,
+        PromotionManager,
+        RelayNode,
+        SocketFeed,
+    )
+
+    clients = args.serve_clients
+    n_relays = max(1, args.tree_relays)
+    n_leaves = max(1, args.tree_followers)
+    kill_after = args.tree_kill_after_acks
+    if kill_after <= 0:
+        import random as _random
+
+        kill_after = _random.Random(args.seed).randrange(400, 700)
+    snap_after = args.crash_snapshot_after
+    if snap_after < 0:
+        snap_after = kill_after // 3
+    base = args.tree_dir or tempfile.mkdtemp(prefix="nr-tree-")
+    primary_d = os.path.join(base, "primary")
+    feed_d = os.path.join(base, "feed")
+    os.makedirs(primary_d, exist_ok=True)
+    os.makedirs(feed_d, exist_ok=True)
+    acks_path = os.path.join(primary_d, "acks.log")
+    port_file = os.path.join(base, "primary.port")
+    failures: list[str] = []
+    dispatch = make_seqreg(clients)
+    aw = dispatch.arg_width
+
+    child_log = open(os.path.join(base, "child.log"), "w")
+    child = subprocess.Popen(
+        [
+            sys.executable, os.path.abspath(__file__),
+            "--follower-primary",
+            "--crash-dir", primary_d,
+            "--feed-dir", feed_d,
+            "--tree-port-file", port_file,
+            "--tree-min-downstream", str(n_relays),
+            "--serve-clients", str(clients),
+            "--serve-replicas", str(args.serve_replicas),
+            "--serve-queue-depth", str(args.serve_queue_depth),
+            "--serve-batch", str(args.serve_batch),
+            "--serve-linger", str(args.serve_linger),
+            "--crash-durability", "batch",
+            "--crash-snapshot-after", str(snap_after),
+            "--seed", str(args.seed),
+        ],
+        stdout=child_log, stderr=child_log,
+    )
+
+    def fail_out(msg: str) -> int:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        print(f"# FAIL: {msg} (see {base}/child.log)", file=sys.stderr)
+        if child.poll() is None:
+            os.kill(child.pid, signal.SIGKILL)
+        return 1
+
+    t_wait = time.monotonic() + args.tree_timeout
+    while not os.path.exists(port_file):
+        if child.poll() is not None or time.monotonic() > t_wait:
+            return fail_out("primary never published its port")
+        time.sleep(0.01)
+    with open(port_file) as f:
+        p_host, p_port = f.read().split()
+
+    # ---- the tree: relays in this process, leaves as processes -----
+    relays = [
+        RelayNode(
+            SocketFeed(p_host, int(p_port), arg_width=aw),
+            os.path.join(base, f"relay{r}"), arg_width=aw,
+            poll_s=0.001, name=f"relay{r}",
+        )
+        for r in range(n_relays)
+    ]
+
+    def ack_lines() -> list[str]:
+        try:
+            with open(acks_path) as f:
+                data = f.read()
+        except FileNotFoundError:
+            return []
+        return [ln for ln in data.split("\n")[:-1] if ln]
+
+    def wait_acks(n: int, why: str) -> bool:
+        t_end = time.monotonic() + args.tree_timeout
+        while len(ack_lines()) < n:
+            if child.poll() is not None or time.monotonic() > t_end:
+                failures.append(
+                    f"primary reached only {len(ack_lines())} acks "
+                    f"waiting for {n} ({why})"
+                )
+                return False
+            time.sleep(0.02)
+        return True
+
+    def spawn_leaf(idx: int, bootstrap: bool):
+        relay = relays[idx % n_relays]
+        d = os.path.join(base, f"leaf{idx}")
+        ready = os.path.join(base, f"leaf{idx}.ready")
+        result = os.path.join(base, f"leaf{idx}.json")
+        for stale in (ready, result):  # the single-window leaf's dir
+            try:  # is reused (crash-resume); its barrier files not
+                os.remove(stale)
+            except FileNotFoundError:
+                pass
+        target = len(ack_lines())
+        proc = subprocess.Popen(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--tree-follower",
+                "--crash-dir", d,
+                "--tree-connect",
+                f"{relay.address[0]}:{relay.address[1]}",
+                "--tree-target", str(target),
+                "--tree-ready-file", ready,
+                "--tree-go-file", os.path.join(base, "go"),
+                "--tree-result-file", result,
+                "--tree-read-seconds", str(args.tree_read_seconds),
+                "--tree-timeout", str(args.tree_timeout),
+                "--tree-bootstrap", "1" if bootstrap else "0",
+                "--serve-clients", str(clients),
+            ],
+            stdout=child_log, stderr=child_log,
+        )
+        return proc, ready, result
+
+    def run_leaves(count: int, tag: str):
+        """Spawn `count` leaves, barrier them on the go file, collect
+        results; returns (results, primary ack rate over the window).
+        A hung or crashed leaf fails the PHASE (diagnostics + leaf
+        cleanup), never the harness with a raw traceback."""
+        go = os.path.join(base, "go")
+        if os.path.exists(go):
+            os.remove(go)
+        leaves = [spawn_leaf(i, bootstrap=False)
+                  for i in range(count)]
+        leaf_procs.extend(pr for pr, _, _ in leaves)
+        try:
+            t_end = time.monotonic() + args.tree_timeout
+            while not all(os.path.exists(r) for _, r, _ in leaves):
+                if (time.monotonic() > t_end
+                        or any(pr.poll() is not None
+                               for pr, _, _ in leaves)):
+                    failures.append(
+                        f"{tag}: a leaf exited or never caught up"
+                    )
+                    return [], 0.0
+                time.sleep(0.02)
+            acks0 = len(ack_lines())
+            t0 = time.monotonic()
+            with open(go, "w") as f:
+                f.write("go")
+            results = []
+            for pr, _, res in leaves:
+                pr.wait(timeout=args.tree_timeout)
+                with open(res) as f:
+                    results.append(json.load(f))
+            # a leaf that never replicated must not count: its reads
+            # against near-empty state would inflate the scaling gate
+            bad = [r for r in results if not r.get("caught_up")]
+            if bad:
+                failures.append(
+                    f"{tag}: {len(bad)} leaf/leaves reported reads "
+                    f"without catching up (applied "
+                    f"{[r.get('applied') for r in bad]})"
+                )
+                return [], 0.0
+            window = max(time.monotonic() - t0, 1e-6)
+            ack_rate = (len(ack_lines()) - acks0) / window
+            return results, ack_rate
+        except (OSError, subprocess.TimeoutExpired,
+                json.JSONDecodeError) as e:
+            failures.append(
+                f"{tag}: leaf harness failed "
+                f"({type(e).__name__}: {e})"
+            )
+            return [], 0.0
+        finally:
+            for pr, _, _ in leaves:
+                if pr.poll() is None:
+                    pr.kill()
+
+    report = None
+    run = {}
+    candidate = None
+    leaf_procs: list = []
+    try:
+        # ---- phase 1: read scale-out (1 leaf, then all leaves) -----
+        if not wait_acks(max(snap_after, 50), "warmup"):
+            return fail_out("primary produced no load")
+        single_res, single_ack_rate = run_leaves(1, "single")
+        if not single_res:
+            return fail_out("single-leaf window failed")
+        all_res, all_ack_rate = run_leaves(n_leaves, "aggregate")
+        if not all_res:
+            return fail_out("aggregate window failed")
+        single_tput = single_res[0]["reads"] / single_res[0]["seconds"]
+        agg_tput = sum(r["reads"] / r["seconds"] for r in all_res)
+        scaling = agg_tput / max(single_tput, 1e-9)
+        hold = all_ack_rate / max(single_ack_rate, 1e-9)
+        if scaling < args.tree_scaling_min:
+            failures.append(
+                f"aggregate follower read throughput does not scale: "
+                f"{agg_tput:.0f} ops/s across {n_leaves} leaves vs "
+                f"{single_tput:.0f} single ({scaling:.2f}x < "
+                f"{args.tree_scaling_min}x)"
+            )
+        if hold < args.tree_primary_hold:
+            failures.append(
+                f"primary write throughput collapsed under the tree: "
+                f"{all_ack_rate:.0f} acks/s with {n_leaves} leaves vs "
+                f"{single_ack_rate:.0f} with one ({hold:.2f} < "
+                f"{args.tree_primary_hold})"
+            )
+
+        # ---- phase 2: snapshot bootstrap vs full-WAL replay --------
+        if not wait_acks(snap_after + 20, "snapshot"):
+            return fail_out("no snapshot landed")
+        target = len(ack_lines())
+        t0 = time.perf_counter()
+        cold = Follower(
+            dispatch, SocketFeed(*relays[0].address, arg_width=aw),
+            os.path.join(base, "cold-bootstrap"),
+            nr_kwargs=dict(n_replicas=1, log_entries=1 << 15,
+                           gc_slack=512, exec_window=256),
+            poll_s=0.001, bootstrap=True, name="cold-bootstrap",
+        )
+        if not cold.wait_applied(target, timeout=args.tree_timeout):
+            failures.append("bootstrap follower never caught up")
+        bootstrap_s = time.perf_counter() - t0
+        boot_pos = (cold.bootstrap_report[0]
+                    if cold.bootstrap_report else 0)
+        if cold.bootstrap_report is None:
+            failures.append(
+                "cold follower did not bootstrap from a snapshot "
+                "(none served?)"
+            )
+        elif cold.recovery_report.snapshot_pos != boot_pos:
+            failures.append(
+                f"bootstrap snapshot at {boot_pos} was fetched but "
+                f"recovery booted from "
+                f"{cold.recovery_report.snapshot_pos}"
+            )
+        t0 = time.perf_counter()
+        full = Follower(
+            dispatch, SocketFeed(*relays[0].address, arg_width=aw),
+            os.path.join(base, "cold-full"),
+            nr_kwargs=dict(n_replicas=1, log_entries=1 << 15,
+                           gc_slack=512, exec_window=256),
+            poll_s=0.001, bootstrap=False, name="cold-full",
+        )
+        if not full.wait_applied(target, timeout=args.tree_timeout):
+            failures.append("full-replay follower never caught up")
+        full_replay_s = time.perf_counter() - t0
+        # bit-identity between the two catch-up paths: both keep
+        # applying live traffic, so compare their journaled histories
+        # position-aligned over the common range (deterministic
+        # replay then makes the states folds of the same history —
+        # the clause tests/test_transport.py pins state-level at a
+        # quiesced barrier)
+        common = min(cold.applied_pos(), full.applied_pos())
+        base_pos = max(cold.nr.wal.base, full.nr.wal.base)
+
+        def flat_ops(it, upto):
+            for rec in it:
+                for j in range(rec.count):
+                    if rec.pos + j >= upto:
+                        return
+                    yield (rec.pos + j, int(rec.opcodes[j]),
+                           tuple(int(a) for a in rec.args[j]))
+
+        for pa, pb in zip(
+            flat_ops(cold.nr.wal.records(base_pos), common),
+            flat_ops(full.nr.wal.records(base_pos), common),
+        ):
+            if pa != pb:
+                failures.append(
+                    f"bootstrap history diverges from full replay at "
+                    f"{pa[0]}: {pa[1:]} vs {pb[1:]}"
+                )
+                break
+        full.close()
+        if bootstrap_s >= full_replay_s:
+            failures.append(
+                f"snapshot bootstrap ({bootstrap_s:.2f}s) did not "
+                f"beat full-WAL replay ({full_replay_s:.2f}s)"
+            )
+
+        # ---- phase 3: SIGKILL -> mid-tree promotion ----------------
+        candidate = cold  # keeps applying through relay 0
+        manager = PromotionManager(
+            SocketFeed(*relays[0].address, arg_width=aw), [candidate],
+            heartbeat_timeout_s=args.follower_heartbeat_timeout,
+            check_interval_s=0.03,
+        )
+        manager.start()
+        if not wait_acks(kill_after, "kill point"):
+            return fail_out("never reached the kill point")
+        os.kill(child.pid, signal.SIGKILL)
+        t_kill = time.monotonic()
+        child.wait()
+        report = manager.wait(timeout=args.tree_timeout)
+        rto_wall = time.monotonic() - t_kill
+        if report is None:
+            return fail_out("mid-tree promotion did not complete")
+        if not candidate.promoted or candidate.frontend.read_only:
+            failures.append(
+                "candidate not serving writes after promotion"
+            )
+
+        acked_max = [0] * clients
+        acked_total = 0
+        for ln in ack_lines():
+            parts = ln.split()
+            if parts[0] == "ERR":
+                failures.append(f"primary oracle violation: {ln}")
+                continue
+            c, i = int(parts[0]), int(parts[1])
+            if i != acked_max[c] + 1:
+                failures.append(
+                    f"client {c} ack sequence broken at {i}"
+                )
+            acked_max[c] = max(acked_max[c], i)
+            acked_total += 1
+        lost = 0
+        values = []
+        for c in range(clients):
+            v = candidate.frontend.read((SR_GET, c), rid=0)
+            values.append(v)
+            if v < acked_max[c]:
+                lost += acked_max[c] - v
+                failures.append(
+                    f"client {c}: acked to {acked_max[c]} but the "
+                    f"promoted mid-tree follower holds {v} "
+                    f"(LOST ACKED WRITES)"
+                )
+        duplicated = 0
+        seen_next = [1] * clients
+        for rec in candidate.nr.wal.records(0):
+            for _opc, row in zip(rec.opcodes, rec.args):
+                c, v = int(row[0]) % clients, int(row[1])
+                if v < seen_next[c]:
+                    duplicated += 1
+                    failures.append(
+                        f"client {c}: value {v} DUPLICATED in the "
+                        f"promoted follower's WAL"
+                    )
+                elif v > seen_next[c]:
+                    seen_next[c] = v + 1
+                else:
+                    seen_next[c] += 1
+
+        # zombie fencing over the wire: a restarted zombie primary
+        # re-serves its old feed on the old port and publishes a
+        # record stamped with its superseded epoch — relay 0's
+        # degraded-mode client reconnects and DELIVERS it, and the
+        # fence the promotion pushed into the relay must drop it
+        # before it reaches the subtree's journal
+        from node_replication_tpu.repl import FeedServer
+
+        zfeed = DirectoryFeed(feed_d, arg_width=aw)
+        zcursor = relays[0].cursor()
+        ztail = relays[0].local.tail_pos()
+        # the fence never reached the dead primary's feed (its server
+        # died), so the zombie's local epoch check passes — exactly
+        # the split-brain publish the relay-side fence exists for
+        zfeed.publish(zfeed.epoch(), zcursor,
+                      np.zeros(1, np.int32),
+                      np.zeros((1, aw), np.int32))
+        zsrv = FeedServer(zfeed, host=p_host, port=int(p_port))
+        try:
+            t_end = time.monotonic() + 10.0
+            while (relays[0].cursor() <= zcursor
+                   and time.monotonic() < t_end):
+                time.sleep(0.01)
+            if relays[0].cursor() <= zcursor:
+                failures.append(
+                    "zombie probe inconclusive: relay 0 never "
+                    "observed the zombie record"
+                )
+            if relays[0].local.tail_pos() != ztail:
+                failures.append(
+                    "a record stamped with the dead primary's epoch "
+                    "reached the relay journal (zombie not fenced)"
+                )
+        finally:
+            zsrv.close()
+
+        post_ops = 0
+        for c in range(clients):
+            for i in range(values[c] + 1, values[c] + 4):
+                resp = candidate.frontend.call((SR_SET, c, i), rid=0)
+                if resp != i - 1:
+                    failures.append(
+                        f"post-promotion client {c} op {i}: expected "
+                        f"{i - 1}, got {resp}"
+                    )
+                post_ops += 1
+
+        run = {
+            "relays": n_relays,
+            "followers": n_leaves,
+            "acked": acked_total,
+            "agg_reads_ops": agg_tput,
+            "single_reads_ops": single_tput,
+            "read_scaling_x": scaling,
+            "primary_tput_hold": hold,
+            "bootstrap_pos": boot_pos,
+            "bootstrap_s": bootstrap_s,
+            "full_replay_s": full_replay_s,
+            "bootstrap_speedup_x": full_replay_s
+            / max(bootstrap_s, 1e-9),
+            "detect_s": report.detect_s,
+            "promote_s": report.promote_s,
+            "rto_s": report.rto_s,
+            "lost": lost,
+            "duplicated": duplicated,
+            "post_restart_ops": post_ops,
+        }
+    finally:
+        for pr in leaf_procs:
+            if pr.poll() is None:
+                pr.kill()
+        if candidate is not None:
+            candidate.close()
+        for relay in relays:
+            relay.close()
+        if child.poll() is None:
+            os.kill(child.pid, signal.SIGKILL)
+            child.wait()
+        child_log.close()
+
+    append_tree_csv(args.serve_out, tree_rows("bench", run))
+    print(json.dumps({
+        "metric": "tree_replication",
+        "value": round(report.rto_s, 4),
+        "unit": "seconds_rto",
+        "rto_wall_s": round(rto_wall, 4),
+        **{k: (round(v, 4) if isinstance(v, float) else v)
+           for k, v in run.items()},
+    }))
+    if not args.tree_dir:
+        shutil.rmtree(base, ignore_errors=True)
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# tree OK: {n_relays} relay(s) x {n_leaves} leaf "
+        f"process(es); reads {run['single_reads_ops']:.0f} -> "
+        f"{run['agg_reads_ops']:.0f} ops/s ({run['read_scaling_x']:.2f}x, "
+        f"primary hold {run['primary_tput_hold']:.2f}); bootstrap "
+        f"{run['bootstrap_s']:.2f}s vs full replay "
+        f"{run['full_replay_s']:.2f}s "
+        f"({run['bootstrap_speedup_x']:.2f}x); SIGKILL -> mid-tree "
+        f"promotion in {report.rto_s:.3f}s, lost 0, duplicated 0, "
+        f"zombie fenced, served {run['post_restart_ops']} more ops",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--replicas", type=int, default=4096)
@@ -2082,14 +2662,69 @@ def main():
                           help="parent gives up waiting for the kill "
                                "point / promotion after this many "
                                "seconds")
+    tree = p.add_argument_group(
+        "tree", "multi-host replication tree benchmark (--tree): "
+                "fork a primary serving its feed + snapshots over "
+                "TCP, build a primary -> relays -> leaf-process "
+                "topology on localhost, and exit 1 unless aggregate "
+                "leaf reads scale while primary writes hold, "
+                "snapshot bootstrap beats full-WAL replay, and a "
+                "SIGKILL promotes a mid-tree follower with zero "
+                "lost/duplicated acked writes")
+    tree.add_argument("--tree", action="store_true",
+                      help="run the replication-tree benchmark "
+                           "(reuses the --serve-* knobs for load "
+                           "shape)")
+    tree.add_argument("--tree-relays", type=int, default=2,
+                      help="interior relay nodes (each one TCP "
+                           "stream off the primary)")
+    tree.add_argument("--tree-followers", type=int, default=4,
+                      help="leaf follower PROCESSES for the "
+                           "read-scale-out phase")
+    tree.add_argument("--tree-read-seconds", type=float, default=2.0,
+                      help="measured read window per leaf phase")
+    tree.add_argument("--tree-kill-after-acks", type=int, default=0,
+                      help="SIGKILL the primary once this many acks "
+                           "shipped (0 = seeded from --seed)")
+    tree.add_argument("--tree-scaling-min", type=float, default=1.25,
+                      help="aggregate/single leaf read-throughput "
+                           "gate (conservative: CI cores bound it "
+                           "well below the leaf count)")
+    tree.add_argument("--tree-primary-hold", type=float, default=0.4,
+                      help="primary ack-rate hold gate (all-leaves "
+                           "rate / single-leaf rate)")
+    tree.add_argument("--tree-timeout", type=float, default=120.0,
+                      help="per-phase give-up budget")
+    tree.add_argument("--tree-dir", default=None,
+                      help="working directory (default: a temp dir, "
+                           "removed after a clean run)")
+    tree.add_argument("--tree-follower", action="store_true",
+                      help=argparse.SUPPRESS)  # internal: leaf proc
+    tree.add_argument("--tree-connect", default=None,
+                      help=argparse.SUPPRESS)  # internal: host:port
+    tree.add_argument("--tree-target", type=int, default=0,
+                      help=argparse.SUPPRESS)  # internal: catch-up pos
+    tree.add_argument("--tree-ready-file", default=None,
+                      help=argparse.SUPPRESS)  # internal
+    tree.add_argument("--tree-go-file", default=None,
+                      help=argparse.SUPPRESS)  # internal
+    tree.add_argument("--tree-result-file", default=None,
+                      help=argparse.SUPPRESS)  # internal
+    tree.add_argument("--tree-bootstrap", type=int, default=1,
+                      help=argparse.SUPPRESS)  # internal: leaf flag
+    tree.add_argument("--tree-port-file", default=None,
+                      help=argparse.SUPPRESS)  # internal: primary
+    tree.add_argument("--tree-min-downstream", type=int, default=1,
+                      help=argparse.SUPPRESS)  # internal: ack gate
     args = p.parse_args()
     if args.max_attempts < 1:
         p.error("--max-attempts must be >= 1")
     if sum(map(bool, (args.chaos, args.serve, args.crash,
-                      args.follower, args.overload, args.mesh,
-                      args.kernel))) > 1:
-        p.error("--chaos, --serve, --crash, --follower, --overload, "
-                "--mesh and --kernel are mutually exclusive")
+                      args.follower, args.tree, args.overload,
+                      args.mesh, args.kernel))) > 1:
+        p.error("--chaos, --serve, --crash, --follower, --tree, "
+                "--overload, --mesh and --kernel are mutually "
+                "exclusive")
     if args.crash_child:
         if not args.crash_dir:
             p.error("--crash-child requires --crash-dir")
@@ -2099,8 +2734,16 @@ def main():
             p.error("--follower-primary requires --crash-dir and "
                     "--feed-dir")
         sys.exit(follower_primary_main(args))
+    if args.tree_follower:
+        if not args.crash_dir or not args.tree_connect \
+                or not args.tree_result_file:
+            p.error("--tree-follower requires --crash-dir, "
+                    "--tree-connect and --tree-result-file")
+        sys.exit(tree_follower_main(args))
     if args.follower:
         sys.exit(follower_main(args))
+    if args.tree:
+        sys.exit(tree_main(args))
     if args.crash:
         sys.exit(crash_main(args))
     if args.chaos:

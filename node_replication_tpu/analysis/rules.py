@@ -1595,3 +1595,84 @@ def aliased_pallas_planes(mod: ModuleInfo,
                     f"un-blocked ANY/HBM ref with explicit DMA "
                     f"(ops/pallas_ring.py)",
                 )
+
+
+# --------------------------------------------------------------------------
+# raw-socket-in-worker
+# --------------------------------------------------------------------------
+
+#: socket calls that block forever without a configured timeout
+_BLOCKING_SOCKET_METHODS = frozenset({
+    "accept", "recv", "recv_into", "recvfrom", "recvmsg",
+})
+
+
+def _timeout_sanctioned_tails(mod: ModuleInfo) -> set[str]:
+    """Receiver tails with a visible timeout configuration anywhere in
+    the module: a `.settimeout(...)` call on that tail. Module-wide on
+    purpose — sockets are typically configured once at their
+    construction site (`__init__`, an accept loop) and blocked on in
+    a different function, and a per-function scope would force
+    re-asserting the timeout at every blocking site."""
+    sanctioned: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "settimeout":
+            tail = _receiver_tail(f.value)
+            if tail is not None:
+                sanctioned.add(tail)
+    return sanctioned
+
+
+@rule(
+    "raw-socket-in-worker", ERROR,
+    "blocking socket accept/recv without a timeout in a repl/ worker "
+    "thread",
+)
+def raw_socket_in_worker(mod: ModuleInfo,
+                         project: Project) -> Iterator[Diagnostic]:
+    """A `accept()`/`recv()` on a timeout-less socket inside a repl/
+    thread target blocks FOREVER on a half-open connection: the worker
+    can never observe its stop flag, `close()` hangs on the join, and
+    a partitioned peer wedges the node instead of degrading it
+    (`repl/transport.py`'s liveness discipline). Every socket a repl/
+    worker loop blocks on must carry a `settimeout(...)` — visible on
+    the same receiver name somewhere in the module (construction-site
+    configuration counts) — or route its deadline through the
+    injectable clock. Scoped to repl/ thread targets (the same
+    transitive thread-target closure `swallowed-worker-exception`
+    walks): request/response helpers on caller threads time out into
+    the CALLER's error handling and are its business."""
+    parts = re.split(r"[\\/]+", mod.path)
+    if "repl" not in parts[:-1]:
+        return
+    sanctioned = _timeout_sanctioned_tails(mod)
+    for name, fn in sorted(_thread_target_functions(mod,
+                                                    project).items()):
+        label = getattr(fn, "name", name)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _BLOCKING_SOCKET_METHODS):
+                continue
+            tail = _receiver_tail(f.value)
+            if tail is None:
+                continue
+            low = tail.lower()
+            if not any(tok in low for tok in
+                       ("sock", "conn", "listener", "client")):
+                continue  # not socket-shaped (e.g. a queue's recv)
+            if tail in sanctioned:
+                continue
+            yield _diag(
+                mod, node, "raw-socket-in-worker",
+                f"{label}: blocking .{f.attr}() on `{tail}` with no "
+                f"settimeout anywhere in the module — a half-open "
+                f"peer wedges this repl/ worker thread forever; "
+                f"configure a socket timeout (or an injected-clock "
+                f"deadline) so the loop can observe its stop flag",
+            )

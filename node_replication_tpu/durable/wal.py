@@ -153,6 +153,40 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+def durable_publish(path: str, data: bytes, fsync: bool = True) -> None:
+    """Atomically publish `data` at `path`: tmp file + flush (+ fsync
+    when `fsync`) + `os.replace` (+ parent-dir fsync). THE hardened
+    publish path for every small control file the durability and
+    replication planes expose to other processes — snapshots
+    (`core/checkpoint.py` inlines the same discipline), the feed's
+    `EPOCH` fence and `HEARTBEAT` beacon (`repl/feed.py`), and fetched
+    snapshot files (`repl/transport.py`). A reader can NEVER observe a
+    torn file: it sees the old content or the new, and with `fsync`
+    the new content survives a crash of the publisher. `fsync=False`
+    keeps the rename atomicity (no torn reads) without the per-publish
+    disk flush — right for high-rate beacons whose loss is harmless
+    but whose tearing is not. The tmp name is pid- AND thread-tagged
+    so concurrent publishers — other processes, or two server
+    connection threads fencing the same feed — cannot corrupt each
+    other's staging; a failed publish removes its tmp file."""
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync:
+        _fsync_dir(os.path.dirname(path) or ".")
+
+
 class WriteAheadLog:
     """Append-only segmented WAL for encoded op batches.
 

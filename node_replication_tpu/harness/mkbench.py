@@ -61,6 +61,7 @@ SERVE_CSV = "serve_benchmarks.csv"
 CHAOS_CSV = "chaos_benchmarks.csv"
 RECOVERY_CSV = "recovery_benchmarks.csv"
 REPLICATION_CSV = "replication_benchmarks.csv"
+TREE_CSV = "tree_benchmarks.csv"
 OVERLOAD_CSV = "overload_benchmarks.csv"
 MESH_CSV = "mesh_benchmarks.csv"
 # One row per (device count) point of a mesh scaling curve
@@ -101,6 +102,22 @@ _REPLICATION_FIELDS = [
     "name", "clients", "acked", "kill_after_acks", "max_lag_pos",
     "reads", "stale_reads", "applied_pos", "new_epoch",
     "drained_records", "detect_s", "promote_s", "rto_s",
+    "lost", "duplicated", "post_restart_ops",
+]
+# One row per tree-replication measurement (`bench.py --tree`): a
+# socket-transported 1 -> relays -> followers topology. The three
+# gated claims, one column group each: `agg_reads_ops`/`read_scaling_x`
+# (aggregate follower read throughput vs one follower — must scale)
+# with `primary_tput_hold` (primary write throughput under the full
+# tree / alone, must hold within tolerance), `bootstrap_s` vs
+# `full_replay_s` (a snapshot-bootstrapped cold follower must catch
+# up faster than full-WAL replay), and the mid-tree failover block
+# (detect/promote/rto + `lost`/`duplicated`, both must be 0).
+_TREE_FIELDS = [
+    "name", "relays", "followers", "acked", "agg_reads_ops",
+    "single_reads_ops", "read_scaling_x", "primary_tput_hold",
+    "bootstrap_pos", "bootstrap_s", "full_replay_s",
+    "bootstrap_speedup_x", "detect_s", "promote_s", "rto_s",
     "lost", "duplicated", "post_restart_ops",
 ]
 # One row per crash-recovery measurement (`bench.py --crash`): what
@@ -1482,6 +1499,35 @@ def append_overload_csv(out_dir: str, rows: list[dict]) -> None:
 def append_replication_csv(out_dir: str, rows: list[dict]) -> None:
     _append_csv(os.path.join(out_dir, REPLICATION_CSV),
                 _REPLICATION_FIELDS, rows)
+
+
+def tree_rows(name: str, run: dict) -> list[dict]:
+    """The TREE_CSV row for one `bench.py --tree` run dict (see
+    `_TREE_FIELDS` for the gated column groups)."""
+    return [{
+        "name": f"{name}/tree-seqreg",
+        "relays": run["relays"],
+        "followers": run["followers"],
+        "acked": run["acked"],
+        "agg_reads_ops": round(run["agg_reads_ops"], 1),
+        "single_reads_ops": round(run["single_reads_ops"], 1),
+        "read_scaling_x": round(run["read_scaling_x"], 3),
+        "primary_tput_hold": round(run["primary_tput_hold"], 3),
+        "bootstrap_pos": run["bootstrap_pos"],
+        "bootstrap_s": round(run["bootstrap_s"], 4),
+        "full_replay_s": round(run["full_replay_s"], 4),
+        "bootstrap_speedup_x": round(run["bootstrap_speedup_x"], 3),
+        "detect_s": round(run["detect_s"], 4),
+        "promote_s": round(run["promote_s"], 4),
+        "rto_s": round(run["rto_s"], 4),
+        "lost": run["lost"],
+        "duplicated": run["duplicated"],
+        "post_restart_ops": run["post_restart_ops"],
+    }]
+
+
+def append_tree_csv(out_dir: str, rows: list[dict]) -> None:
+    _append_csv(os.path.join(out_dir, TREE_CSV), _TREE_FIELDS, rows)
 
 
 def measure_native(

@@ -328,7 +328,12 @@ def analyze(events: list[dict]) -> dict:
     repl_other = [e for e in events
                   if str(e.get("event", "")).startswith("repl-")
                   and e.get("event") not in ("repl-ship", "repl-apply")]
-    if ships or applies or repl_other:
+    # transport lane events count toward section presence too: a
+    # relay-only process emits transport-*/relay-* but no repl-*
+    transport_events = [e for e in events
+                        if str(e.get("event", ""))
+                        .startswith(("transport-", "relay-"))]
+    if ships or applies or repl_other or transport_events:
         lag_tl: dict[int, int] = {}
         for e in applies:
             sec = int(_event_time(e, mono0, ts0))
@@ -355,6 +360,12 @@ def analyze(events: list[dict]) -> dict:
         def _count(name):
             return sum(1 for e in repl_other if e.get("event") == name)
 
+        # transport lane (repl/transport.py + repl/relay.py): wire
+        # lifecycle, relay forwarding/fencing, snapshot bootstraps
+        def _tcount(name):
+            return sum(1 for e in transport_events
+                       if e.get("event") == name)
+
         repl = {
             "shipped_records": len(ships),
             "shipped_ops": sum(int(e.get("n", 0)) for e in ships),
@@ -367,6 +378,15 @@ def analyze(events: list[dict]) -> dict:
             "ship_errors": _count("repl-ship-error"),
             "apply_errors": _count("repl-apply-error"),
             "fences": _count("repl-fence"),
+            "transport_connects": _tcount("transport-connect"),
+            "transport_reconnects": _tcount("transport-reconnect"),
+            "transport_errors": _tcount("transport-error"),
+            "relay_fenced": _tcount("relay-fenced"),
+            "relay_errors": _tcount("relay-error"),
+            "snapshots_served": _tcount("transport-snapshot-served"),
+            "snapshots_fetched": _tcount("transport-snapshot-fetched"),
+            "bootstraps": _count("repl-bootstrap"),
+            "bootstrap_failures": _count("repl-bootstrap-failed"),
             "apply_lag_timeline": dict(sorted(lag_tl.items())),
             "promotions": promotions,
         }
@@ -643,6 +663,19 @@ def render(report: dict, out=None) -> None:
         if repl["ship_errors"] or repl["apply_errors"]:
             w(f"  ship errors: {repl['ship_errors']}   "
               f"apply errors: {repl['apply_errors']}\n")
+        if repl.get("transport_connects") or repl.get("relay_fenced") \
+                or repl.get("transport_errors"):
+            w(f"  transport: {repl['transport_connects']} connect(s), "
+              f"{repl['transport_reconnects']} reconnect(s), "
+              f"{repl['transport_errors']} server error(s)   "
+              f"relay fenced: {repl['relay_fenced']}   "
+              f"relay errors: {repl['relay_errors']}\n")
+        if repl.get("bootstraps") or repl.get("snapshots_served") \
+                or repl.get("bootstrap_failures"):
+            w(f"  snapshot bootstrap: {repl['bootstraps']} "
+              f"bootstrap(s) ({repl['bootstrap_failures']} fell back "
+              f"to full replay), {repl['snapshots_served']} "
+              f"served / {repl['snapshots_fetched']} fetched\n")
         tl = repl["apply_lag_timeline"]
         if tl:
             w("  apply-lag timeline (max positions behind feed tail "

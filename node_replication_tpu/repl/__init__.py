@@ -28,6 +28,20 @@ already proves.
     mgr = PromotionManager(feed, [f])
     mgr.start()                                       # heartbeat watch
     report = mgr.wait()                               # measured RTO
+
+Cross-host (ISSUE 12): `transport.py` carries the same stream over
+TCP — `FeedServer` serves any feed-shaped source (plus snapshots for
+cold-follower bootstrap), `SocketFeed` is the far end's drop-in feed —
+and `relay.py`'s `RelayNode` is a feed-of-feeds interior node, so a
+1→R→N tree ships each record once per edge instead of N× from the
+primary:
+
+    srv = FeedServer(feed, snapshot_dir=primary_dir)  # on the primary
+    up = SocketFeed(*srv.address, arg_width=aw)       # another host
+    relay = RelayNode(up, directory=relay_dir, arg_width=aw)
+    leaf = SocketFeed(*relay.address, arg_width=aw)
+    f = Follower(dispatch, leaf, directory=my_dir)    # bootstraps from
+    ...                                               # the snapshot
 """
 
 from node_replication_tpu.repl.feed import (
@@ -43,10 +57,18 @@ from node_replication_tpu.repl.promote import (
     PromotionManager,
     PromotionReport,
 )
+from node_replication_tpu.repl.relay import RelayNode
 from node_replication_tpu.repl.shipper import (
     SHIP_PIN,
     ReplicationShipper,
     ShipError,
+)
+from node_replication_tpu.repl.transport import (
+    FeedServer,
+    PipeTransport,
+    SocketFeed,
+    TransportError,
+    make_tree_barrier,
 )
 
 __all__ = [
@@ -56,10 +78,16 @@ __all__ = [
     "FeedError",
     "FeedGapError",
     "FeedRecord",
+    "FeedServer",
     "Follower",
+    "PipeTransport",
     "PromotionManager",
     "PromotionReport",
+    "RelayNode",
     "ReplicationShipper",
     "SHIP_PIN",
     "ShipError",
+    "SocketFeed",
+    "TransportError",
+    "make_tree_barrier",
 ]

@@ -6,10 +6,15 @@ A `Feed` carries the WAL's record stream — the same position-chained
 the shipper (`repl/shipper.py`) to any number of followers
 (`repl/follower.py`), each tracking its own read cursor. The transport
 is abstracted so tests are hermetic: `DirectoryFeed` is the bundled
-implementation, a shared directory of one CRC-framed message file per
-shipped record, which models a network feed faithfully (messages can
-arrive torn, duplicated, or with gaps) while staying a pure-stdlib
-filesystem exchange any two local processes can share.
+shared-disk implementation, a directory of one CRC-framed message file
+per shipped record, which models a network feed faithfully (messages
+can arrive torn, duplicated, or with gaps) while staying a pure-stdlib
+filesystem exchange any two local processes can share. Its cross-host
+twin is `repl/transport.py`: `FeedServer` serves any feed-shaped
+source over TCP and `SocketFeed` implements this same read interface
+on the far end, so followers (and relays, `repl/relay.py`) on other
+hosts consume the identical stream under the identical delivery
+rules.
 
 Message format (little-endian): file `rec-<pos:020d>.msg` holds one
 record `u32 length | u32 crc32(payload) | payload` where the payload is
@@ -60,7 +65,7 @@ import zlib
 
 import numpy as np
 
-from node_replication_tpu.durable.wal import _fsync_dir
+from node_replication_tpu.durable.wal import durable_publish
 from node_replication_tpu.obs.metrics import get_registry
 from node_replication_tpu.utils.trace import get_tracer
 
@@ -194,14 +199,8 @@ class DirectoryFeed:
             raise FeedError(
                 f"fence epoch {epoch} must exceed current {current}"
             )
-        path = os.path.join(self.dir, EPOCH_FILE)
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "wb") as f:
-            f.write(str(epoch).encode("ascii"))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        _fsync_dir(self.dir)
+        durable_publish(os.path.join(self.dir, EPOCH_FILE),
+                        str(epoch).encode("ascii"))
         get_tracer().emit("repl-fence", epoch=epoch, previous=current)
         return epoch
 
@@ -352,12 +351,16 @@ class DirectoryFeed:
     # -------------------------------------------------------- heartbeat
 
     def write_heartbeat(self, value: str) -> None:
-        """Publish the primary's liveness beacon (the shipper writes a
-        monotonically changing value each loop). Plain overwrite: the
-        watcher (`repl/promote.py`) detects CHANGE, not content, so a
-        torn read just reads as a change."""
-        with open(os.path.join(self.dir, HEARTBEAT_FILE), "w") as f:
-            f.write(value)
+        """Publish the liveness beacon (the shipper — or a relay
+        forwarding its upstream's beacon — writes a monotonically
+        changing value each loop). Routed through the hardened publish
+        path with `fsync=False`: the atomic rename means a reader (or
+        a downstream `FeedServer` re-serving the value) can never
+        observe a torn beacon — a crashed relay mid-write leaves the
+        previous complete value — while skipping the per-beacon disk
+        flush a lost-on-crash beacon does not need."""
+        durable_publish(os.path.join(self.dir, HEARTBEAT_FILE),
+                        value.encode("utf-8"), fsync=False)
 
     def read_heartbeat(self) -> str | None:
         try:

@@ -87,12 +87,29 @@ class Follower:
         nr_kwargs: dict | None = None,
         auto_start: bool = True,
         name: str = "follower",
+        bootstrap: bool = True,
     ):
         self.name = name
         self._feed = feed
         self._poll_s = float(poll_s)
         self.health = health
         self.health_rid = int(health_rid)
+
+        # snapshot bootstrap (the cold-follower fast path): when the
+        # feed can serve snapshots (`repl/transport.py:SocketFeed`
+        # against a `FeedServer` with a snapshot source), fetch the
+        # newest one strictly past what this directory already covers
+        # BEFORE recovery — `recover_fleet` then digest-validates it,
+        # boots from it, and the apply thread streams only
+        # `[snapshot_pos, tail)` instead of replaying the whole
+        # history. Bounded catch-up; a fetch failure falls back to
+        # full replay (counted), never a dead follower.
+        self.bootstrap_report: tuple[int, str] | None = None
+        fetch = getattr(feed, "fetch_snapshot", None)
+        if bootstrap and fetch is not None:
+            self.bootstrap_report = self._bootstrap_snapshot(
+                directory, fetch
+            )
 
         # boot (or crash-resume) from the follower's own durability
         # directory; the WAL comes back attached at the recovered
@@ -139,6 +156,45 @@ class Follower:
         )
         if auto_start:
             self.start()
+
+    # -------------------------------------------------------- bootstrap
+
+    def _bootstrap_snapshot(self, directory: str,
+                            fetch) -> tuple[int, str] | None:
+        """Fetch the newest upstream snapshot strictly past what this
+        directory's own newest snapshot covers. Returns `(pos, path)`
+        when one landed (then `recover_fleet` validates its digest and
+        boots from it — a corrupt transfer is skipped there, falling
+        back to older bases + longer replay, never trusted blindly)."""
+        from node_replication_tpu.durable.recovery import list_snapshots
+
+        have = 0
+        snaps = list_snapshots(directory)
+        if snaps:
+            have = snaps[0][0]
+        try:
+            got = fetch(directory, min_pos=have)
+        except Exception as e:
+            # a degraded sidecar is not fatal: the apply thread can
+            # always replay the full feed instead
+            get_registry().counter(
+                "repl.snapshot.bootstrap_failures"
+            ).inc()
+            get_tracer().emit("repl-bootstrap-failed", name=self.name,
+                             cause=type(e).__name__)
+            logger.warning(
+                "follower %s: snapshot bootstrap failed (%s: %s); "
+                "falling back to full replay", self.name,
+                type(e).__name__, e,
+            )
+            return None
+        if got is None:
+            return None
+        pos, path = got
+        get_registry().counter("repl.snapshot.bootstraps").inc()
+        get_tracer().emit("repl-bootstrap", name=self.name,
+                         pos=int(pos), had=have)
+        return int(pos), path
 
     # -------------------------------------------------------- lifecycle
 
@@ -371,7 +427,7 @@ class Follower:
 
     # -------------------------------------------------------- promotion
 
-    def promote(self) -> dict:
+    def promote(self, drain_timeout_s: float = 10.0) -> dict:
         """Take over as primary (the election already happened —
         `repl/promote.py` picks the most-advanced follower and calls
         this). Returns a report dict; also counted
@@ -413,6 +469,33 @@ class Follower:
                 if not more:
                     break
                 drained += more
+            # drain VERIFICATION: an empty poll is not proof over a
+            # network feed — `SocketFeed.poll` degrades to [] on a
+            # transient transport failure by design, and concluding
+            # "drained" from a blip would silently drop acked records
+            # the upstream still holds. The fence just succeeded over
+            # the same transport and froze the tail, so re-poll until
+            # the applied cursor covers the feed's readable tail;
+            # past the deadline, FAIL the promotion loudly (the
+            # election can pick another follower) rather than serve a
+            # truncated history. (Local feeds exit on the first
+            # check: their polls never lie.)
+            clock = get_clock()
+            t_dead = clock.now() + float(drain_timeout_s)
+            while True:
+                tail = int(self._feed.tail_pos())
+                if self._applied >= tail:
+                    break
+                if clock.now() >= t_dead:
+                    raise RuntimeError(
+                        f"follower {self.name}: promotion drain "
+                        f"stalled at {self._applied} below the "
+                        f"fenced feed tail {tail} (transport "
+                        f"degraded?) — refusing to serve a "
+                        f"truncated history"
+                    )
+                drained += self._apply_once(drain=True)
+                clock.sleep(min(self._poll_s, 0.01))
         with self._cond:
             self.epoch = new_epoch
             self._promoted = True

@@ -44,8 +44,24 @@ from node_replication_tpu.utils.trace import get_tracer
 
 logger = logging.getLogger("node_replication_tpu")
 
-#: WAL reclamation pin name (`WriteAheadLog.set_pin`)
+#: WAL reclamation pin-name PREFIX (`WriteAheadLog.set_pin`). Each
+#: shipper pins under its own `ship:<n>` key — pins are a shared
+#: namespace on the WAL, and a fan-out primary can run several
+#: consumers at once (two shippers, a snapshot transfer's
+#: `snapshot-server:<n>` pin, `repl/transport.py`), so one consumer's
+#: `clear_pin` must never release another's reclaim floor.
 SHIP_PIN = "ship"
+
+_pin_seq = 0
+_pin_seq_lock = threading.Lock()
+
+
+def _next_pin_name() -> str:
+    global _pin_seq
+    with _pin_seq_lock:
+        n = _pin_seq
+        _pin_seq += 1
+    return f"{SHIP_PIN}:{n}"
 
 
 class ShipError(RuntimeError):
@@ -75,9 +91,13 @@ class ReplicationShipper:
         health=None,
         health_rid: int = 0,
         auto_start: bool = True,
+        pin_name: str | None = None,
     ):
         self._wal = wal
         self._feed = feed
+        #: this shipper's own WAL reclamation pin key (unique per
+        #: instance by default; see `SHIP_PIN`)
+        self.pin_name = pin_name or _next_pin_name()
         #: this primary's fencing epoch (stamped on every record). A
         #: fresh primary adopts the feed's current epoch; a promoted
         #: one passes the bumped epoch explicitly.
@@ -101,7 +121,7 @@ class ReplicationShipper:
                 f"re-seed the feed (the ship pin prevents this on a "
                 f"live attachment)"
             )
-        wal.set_pin(SHIP_PIN, self._cursor)
+        wal.set_pin(self.pin_name, self._cursor)
 
         self._cond = threading.Condition()
         self._published = self._cursor
@@ -140,7 +160,7 @@ class ReplicationShipper:
         if self._thread.ident:
             self._thread.join(timeout)
         if clear_pin:
-            self._wal.clear_pin(SHIP_PIN)
+            self._wal.clear_pin(self.pin_name)
 
     # -------------------------------------------------------- ship loop
 
@@ -180,7 +200,7 @@ class ReplicationShipper:
                 self._published = end
                 self._cond.notify_all()
             # pin AFTER publish: reclamation may now pass this record
-            self._wal.set_pin(SHIP_PIN, end)
+            self._wal.set_pin(self.pin_name, end)
             self._m_records.inc()
             self._m_ops.inc(rec.count)
             lag = max(0, self._wal.durable_tail - end)

@@ -572,7 +572,13 @@ class ServeFrontend:
         #: batch resolves only after its records are SHIPPED to the
         #: follower feed as well as fsynced (ship-before-ack: the
         #: semi-synchronous mode whose acks survive primary loss
-        #: because a promoted follower provably holds them)
+        #: because a promoted follower provably holds them). A tree
+        #: root extends it with downstream receipt:
+        #: `repl/transport.py:make_tree_barrier(shipper, server)`
+        #: additionally waits until every direct relay's poll cursor
+        #: confirms the records — an ack then survives the primary
+        #: being SIGKILLed even though the feed dies with it, because
+        #: every subtree already holds the bytes.
         self.ack_barrier: Callable[[int], None] | None = None
 
         reg = get_registry()

@@ -333,6 +333,28 @@ def generate_case(
     # the canary re-opens)
     cut = rng.randrange(len(steps) // 2, len(steps))
     steps[cut:cut] = [["snapshot"], ["sync"]]
+    # wire-fault lane (ISSUE 12): the follower consumes the feed
+    # through an in-memory `PipeTransport` twin of the socket client,
+    # and a FRESH rng stream inserts disconnect/reconnect windows and
+    # partitions — stream gaps, duplicate delivery after a rewound
+    # reconnect, and frozen-heartbeat silence are now part of the
+    # 1000-seed sweep. The fresh stream keeps the base repl schedule
+    # (and the canary-seed expectations) byte-identical to the
+    # pre-transport generator; every disconnect is paired with a
+    # reconnect BEFORE the kill/promote tail, so promotion always
+    # fences over a live pipe.
+    prng = random.Random(int(seed) ^ 0x7197E)
+    if prng.random() < 0.6:
+        p = prng.randrange(2, max(3, len(steps) - 2))
+        gap = prng.randrange(0, 4)
+        rew = prng.choice((0, 2, 4, 8))
+        steps[p:p] = [["disconnect"]]
+        q = min(p + 1 + gap, len(steps))
+        steps[q:q] = [["reconnect", rew]]
+    if prng.random() < 0.35:
+        p2 = prng.randrange(1, max(2, len(steps) - 1))
+        steps[p2:p2] = [["partition", prng.randrange(1, 3),
+                         prng.choice((0, 4))]]
     if rng.random() < 0.7:
         steps.append(["wal-sync"])
         if rng.random() < 0.7:
@@ -401,6 +423,7 @@ class _Run:
         self.wal = None
         self.synced_sizes: dict = {}
         self.feed = None
+        self.pipe = None
         self.shipper = None
         self.follower = None
         self.pm = None
@@ -491,6 +514,9 @@ class _Run:
             from node_replication_tpu.repl.shipper import (
                 ReplicationShipper,
             )
+            from node_replication_tpu.repl.transport import (
+                PipeTransport,
+            )
             from node_replication_tpu.serve.frontend import ServeConfig
 
             self.feed = DirectoryFeed(
@@ -500,8 +526,13 @@ class _Run:
             self.shipper = ReplicationShipper(
                 self.wal, self.feed, auto_start=False,
             )
+            # the follower (and the promotion watcher) consume the
+            # feed through the deterministic transport twin, so the
+            # disconnect/reconnect/partition steps model exactly what
+            # a `SocketFeed` client exhibits over a flaky wire
+            self.pipe = PipeTransport(self.feed, rewind=4)
             self.follower = Follower(
-                self.dispatch, self.feed,
+                self.dispatch, self.pipe,
                 directory=os.path.join(self.tmp, "flw"),
                 config=ServeConfig(durability="batch",
                                    batch_linger_s=0.0),
@@ -511,7 +542,7 @@ class _Run:
                            "gc_slack": GC_SLACK},
             )
             self.pm = PromotionManager(
-                self.feed, [self.follower],
+                self.pipe, [self.follower],
                 heartbeat_timeout_s=0.5, check_interval_s=0.1,
             )
             self.oracle_f = make_oracle(self.spec.model,
@@ -526,7 +557,7 @@ class _Run:
             self.follower.close()
         if self.shipper is not None and self.wal is not None:
             try:
-                self.wal.clear_pin("ship")
+                self.wal.clear_pin(self.shipper.pin_name)
             except Exception:
                 pass
         if self.tmp is not None:
@@ -988,6 +1019,41 @@ class _Run:
         state = self.pm.check()
         self.ev(i, "watch", state=state)
 
+    # ---------------------------------------------------- transport steps
+
+    def do_pipe(self, i: int, action: str, rewind: int = 0) -> None:
+        """`disconnect` / `reconnect` on the transport twin: while
+        down, polls go quiet and the cached heartbeat freezes; a
+        rewound reconnect re-delivers applied records (the duplicate
+        path the follower must absorb idempotently)."""
+        if self.pipe is None:
+            self.ev(i, f"{action}-skip")
+            return
+        if action == "disconnect":
+            self.pipe.disconnect()
+            self.ev(i, "disconnect")
+        else:
+            self.pipe.reconnect(int(rewind))
+            self.ev(i, "reconnect", rewind=int(rewind))
+
+    def do_partition(self, i: int, ticks: int, rewind: int,
+                     clock: SimClock) -> None:
+        """A bounded partition: disconnect, let virtual time pass
+        under the promotion watcher (the frozen heartbeat reads as
+        silence — strikes accrue exactly as over a dead socket), then
+        heal with a rewound reconnect."""
+        if self.pipe is None:
+            self.ev(i, "partition-skip")
+            return
+        self.pipe.disconnect()
+        state = None
+        for _ in range(int(ticks)):
+            clock.advance(0.1)
+            if self.pm is not None:
+                state = self.pm.check()
+        self.pipe.reconnect(int(rewind))
+        self.ev(i, "partition", ticks=int(ticks), state=state)
+
     def do_kill(self, i: int) -> None:
         if self.shipper is None or self.primary_dead:
             self.ev(i, "kill-skip")
@@ -1092,6 +1158,10 @@ class _Run:
             if not self.promoted and not self.primary_dead:
                 # drain: finish shipping/applying what is already
                 # durable so the follower checks run at a fixed point
+                # (over a live pipe — a shrunk case may have stripped
+                # the generator's paired reconnect)
+                if self.pipe is not None:
+                    self.pipe.reconnect(0)
                 for _ in range(4):
                     self.do_wal_sync(-1)
                     self.do_ship(-1)
@@ -1179,6 +1249,13 @@ def run_case(spec: CaseSpec) -> CaseResult:
                     run.do_fread(i, list(step[1]), int(step[2]))
                 elif kind == "watch":
                     run.do_watch(i, int(step[1]), clock)
+                elif kind == "disconnect":
+                    run.do_pipe(i, "disconnect")
+                elif kind == "reconnect":
+                    run.do_pipe(i, "reconnect", int(step[1]))
+                elif kind == "partition":
+                    run.do_partition(i, int(step[1]), int(step[2]),
+                                     clock)
                 elif kind == "kill":
                     run.do_kill(i)
                 elif kind == "promote":

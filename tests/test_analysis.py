@@ -975,6 +975,103 @@ class TestUnboundedGrowthInSubsystem:
         assert not firing(diags, "unbounded-growth-in-subsystem")
 
 
+class TestRawSocketInWorker:
+    def _lint_in(self, tmp_path, subdir, source):
+        import textwrap
+        d = tmp_path / subdir
+        d.mkdir(parents=True, exist_ok=True)
+        p = d / "snippet.py"
+        p.write_text(textwrap.dedent(source))
+        diags, errors = run_lint([str(p)])
+        assert not errors, errors
+        return diags
+
+    def test_timeoutless_accept_and_recv_fire(self, tmp_path):
+        # the wedge pattern: a repl/ worker loop blocking on a socket
+        # with no timeout anywhere — a half-open peer parks the thread
+        # forever, past every stop flag and join
+        diags = self._lint_in(tmp_path, "repl", """
+            import socket
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._sock = socket.socket()
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    while True:
+                        conn, _ = self._sock.accept()
+                        self._serve(conn)
+
+                def _serve(self, conn):
+                    return conn.recv(4096)
+        """)
+        assert len(firing(diags, "raw-socket-in-worker")) == 2
+
+    def test_settimeout_discipline_clean(self, tmp_path):
+        # construction-site settimeout sanctions the receiver (the
+        # transport.py shape: configure once, block with a deadline)
+        diags = self._lint_in(tmp_path, "repl", """
+            import socket
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._sock = socket.socket()
+                    self._sock.settimeout(0.2)
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    while True:
+                        conn, _ = self._sock.accept()
+                        conn.settimeout(5.0)
+                        self._serve(conn)
+
+                def _serve(self, conn):
+                    return conn.recv(4096)
+        """)
+        assert not firing(diags, "raw-socket-in-worker")
+
+    def test_non_worker_and_non_socket_clean(self, tmp_path):
+        # a request helper on the CALLER's thread is the caller's
+        # timeout problem, and a non-socket `.recv` (a pipe-like
+        # object) is out of scope
+        diags = self._lint_in(tmp_path, "repl", """
+            import threading
+
+            class Client:
+                def request(self, sock, payload):
+                    sock.send(payload)
+                    return sock.recv(4096)
+
+            class Pump:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    while True:
+                        self._chan.recv(1)
+        """)
+        assert not firing(diags, "raw-socket-in-worker")
+
+    def test_outside_repl_clean(self, tmp_path):
+        diags = self._lint_in(tmp_path, "harness", """
+            import socket
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._sock = socket.socket()
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    while True:
+                        self._sock.accept()
+        """)
+        assert not firing(diags, "raw-socket-in-worker")
+
+
 class TestRepoIsClean:
     def test_package_lints_clean(self):
         # the CI gate, as a test: every violation in the package is

@@ -210,11 +210,14 @@ class TestWalShipPinning:
         # cursor, so however far snapshot floor + GC head advanced,
         # NOTHING unshipped can be reclaimed out from under the feed
         s = ReplicationShipper(wal, feed, auto_start=False)
-        assert wal.pins() == {"ship": 0}
+        # per-instance pin key: `ship:<n>` — two consumers on one WAL
+        # must never collide in the pin namespace
+        assert wal.pins() == {s.pin_name: 0}
+        assert s.pin_name.startswith("ship:")
         assert wal.maybe_reclaim(6) == 0
         s.start()
         s.barrier(6, timeout=10.0)
-        assert wal.pins()["ship"] == 6  # advanced only after publish
+        assert wal.pins()[s.pin_name] == 6  # advanced only after publish
         assert wal.maybe_reclaim(6) >= 1  # now reclaimable
         assert feed.tail_pos() == 6
         s.stop()
@@ -231,6 +234,94 @@ class TestWalShipPinning:
         with pytest.raises(ShipError, match="re-seed"):
             ReplicationShipper(wal, feed, auto_start=False)
         wal.close()
+
+    def test_pin_namespaces_do_not_collide(self, tmp_path):
+        # ISSUE 12 satellite: pins are per-consumer string keys — one
+        # consumer's clear_pin must never release another's reclaim
+        # floor. Two shippers on ONE WAL (fan-out to two feeds) plus a
+        # snapshot-server pin: stopping shipper A leaves B's hold (and
+        # the snapshot transfer's) intact.
+        wal = self._walled(tmp_path / "wal")
+        a = ReplicationShipper(wal, DirectoryFeed(str(tmp_path / "fa")),
+                               auto_start=False)
+        b = ReplicationShipper(wal, DirectoryFeed(str(tmp_path / "fb")),
+                               auto_start=False)
+        assert a.pin_name != b.pin_name
+        wal.set_pin("snapshot-server:0", 2)
+        assert set(wal.pins()) == {a.pin_name, b.pin_name,
+                                   "snapshot-server:0"}
+        a.stop()  # clears ONLY a's pin
+        assert set(wal.pins()) == {b.pin_name, "snapshot-server:0"}
+        # b's pin (cursor 0) still holds the whole history
+        assert wal.maybe_reclaim(6) == 0
+        assert wal.base == 0
+        b.stop()
+        # the reclaim-race half: only the snapshot-server pin remains;
+        # a floor computed above it must still clamp to it
+        assert wal.reclaim(6) >= 1
+        assert wal.base <= 2
+        assert [r.pos for r in wal.records(2)][:1] == [2]
+        wal.close()
+
+
+# ------------------------------------ hardened control-file publishes
+
+
+class TestHardenedPublish:
+    def test_fence_failure_leaves_epoch_intact(self, tmp_path,
+                                               monkeypatch):
+        # ISSUE 12 satellite: EPOCH goes through the fsync-before-
+        # rename publish path (`durable/wal.py:durable_publish`) — a
+        # crash mid-fence can never surface a TORN epoch: readers see
+        # the old value until the atomic rename, and a failed publish
+        # leaves no tmp debris behind
+        feed = DirectoryFeed(str(tmp_path))
+        feed.fence(5)
+        assert feed.epoch() == 5
+
+        def boom(src, dst):
+            raise OSError("simulated crash at publish")
+
+        monkeypatch.setattr(os, "replace", boom)
+        with pytest.raises(OSError):
+            feed.fence(9)
+        monkeypatch.undo()
+        assert feed.epoch() == 5  # old value, never a torn file
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.endswith(".tmp")]
+
+    def test_heartbeat_is_atomic_never_torn(self, tmp_path,
+                                            monkeypatch):
+        # the beacon is renamed into place (fsync skipped by design):
+        # a reader — or a relay re-serving the value downstream — can
+        # never observe a half-written beacon
+        feed = DirectoryFeed(str(tmp_path))
+        feed.write_heartbeat("1 100 6400")
+        replaced = []
+        orig = os.replace
+
+        def spy(src, dst):
+            # the full new content is on disk BEFORE it becomes
+            # visible under the beacon name
+            with open(src) as f:
+                replaced.append(f.read())
+            return orig(src, dst)
+
+        monkeypatch.setattr(os, "replace", spy)
+        feed.write_heartbeat("1 101 6464")
+        assert replaced == ["1 101 6464"]
+        assert feed.read_heartbeat() == "1 101 6464"
+
+        def boom(src, dst):
+            raise OSError("simulated crash at publish")
+
+        monkeypatch.setattr(os, "replace", boom)
+        with pytest.raises(OSError):
+            feed.write_heartbeat("2 1 9999")
+        monkeypatch.undo()
+        assert feed.read_heartbeat() == "1 101 6464"  # previous whole
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.endswith(".tmp")]
 
 
 # ---------------------------------------------------------------- shipper
