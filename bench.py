@@ -1436,8 +1436,20 @@ def follower_primary_main(args) -> int:
         batch_max_ops=args.serve_batch,
         batch_linger_s=args.serve_linger,
         durability=args.crash_durability,
+        # --tree-obs mode: a metrics exporter on a side port, address
+        # published for the parent's FleetCollector
+        obs_port=0 if args.obs_port_file else None,
+        obs_node_id="primary",
     )
     fe = ServeFrontend(nr, cfg)
+    if args.obs_port_file:
+        from node_replication_tpu.durable.wal import durable_publish
+
+        durable_publish(
+            args.obs_port_file,
+            f"{fe.exporter.address[0]} "
+            f"{fe.exporter.address[1]}".encode(),
+        )
     if args.tree_port_file:
         # --tree mode: serve the feed (and snapshots) over TCP and
         # gate acks on downstream receipt too — an ack then implies
@@ -1893,7 +1905,15 @@ def tree_follower_main(args) -> int:
                        gc_slack=512, exec_window=256),
         poll_s=0.002, bootstrap=bool(args.tree_bootstrap),
         name=os.path.basename(args.crash_dir),
+        # --tree-obs mode: exporter on a side port for the collector
+        obs_port=0 if args.obs_port_file else None,
     )
+    if args.obs_port_file:
+        exp = f.frontend.exporter
+        durable_publish(
+            args.obs_port_file,
+            f"{exp.address[0]} {exp.address[1]}".encode(),
+        )
     caught_up = f.wait_applied(args.tree_target,
                                timeout=args.tree_timeout)
     durable_publish(args.tree_ready_file, b"ready")
@@ -1989,6 +2009,58 @@ def tree_main(args) -> int:
     dispatch = make_seqreg(clients)
     aw = dispatch.arg_width
 
+    # ---- fleet observability (--tree-obs): exporters in EVERY tree
+    # process, a FleetCollector merging their scrapes + trace tails
+    # into tree_fleet.jsonl, and a hard gate below on a reconstructed
+    # cross-process per-record hop timeline (obs/export, obs/collect,
+    # obs/report Fleet section)
+    obs = bool(args.tree_obs)
+    collector = None
+    child_env = None
+    fleet_path = None
+    primary_obs_file = os.path.join(base, "primary.obs")
+    if obs:
+        from node_replication_tpu.obs import (
+            get_registry,
+            get_tracer,
+            set_trace_sample,
+        )
+        from node_replication_tpu.obs.collect import FleetCollector
+
+        # this process hosts the relays: same posture as the children.
+        # The tracer must be BUFFERED (ring) — a pre-existing
+        # file-mode NR_TPU_TRACE would export zero events from the
+        # relay exporters and fail the gate below for the wrong
+        # reason, so --tree-obs owns the parent tracer outright.
+        get_registry().enable()
+        t = get_tracer()
+        if not t.enabled or not t.buffered:
+            if t.enabled:
+                print(
+                    "# --tree-obs: re-routing the parent tracer from "
+                    "file mode to ring mode (exporters serve the "
+                    "in-memory tail; the file would export nothing)",
+                    file=sys.stderr,
+                )
+            t.enable(None, ring=1 << 14)
+        set_trace_sample(args.tree_obs_sample)
+        child_env = {
+            **os.environ,
+            "NR_TPU_METRICS": "1",
+            "NR_TPU_TRACE": "mem",
+            "NR_TPU_TRACE_RING": str(1 << 14),
+            "NR_TPU_TRACE_SAMPLE": f"1/{args.tree_obs_sample}",
+        }
+        os.makedirs(args.serve_out, exist_ok=True)
+        fleet_path = os.path.join(args.serve_out, "tree_fleet.jsonl")
+        for stale in (fleet_path, primary_obs_file):
+            # a reused --tree-dir must not hand the collector last
+            # run's (dead) exporter port or append to its merge
+            try:
+                os.remove(stale)
+            except FileNotFoundError:
+                pass
+
     child_log = open(os.path.join(base, "child.log"), "w")
     child = subprocess.Popen(
         [
@@ -2006,8 +2078,9 @@ def tree_main(args) -> int:
             "--crash-durability", "batch",
             "--crash-snapshot-after", str(snap_after),
             "--seed", str(args.seed),
-        ],
-        stdout=child_log, stderr=child_log,
+        ]
+        + (["--obs-port-file", primary_obs_file] if obs else []),
+        stdout=child_log, stderr=child_log, env=child_env,
     )
 
     def fail_out(msg: str) -> int:
@@ -2032,9 +2105,29 @@ def tree_main(args) -> int:
             SocketFeed(p_host, int(p_port), arg_width=aw),
             os.path.join(base, f"relay{r}"), arg_width=aw,
             poll_s=0.001, name=f"relay{r}",
+            obs_port=0 if obs else None,
         )
         for r in range(n_relays)
     ]
+    if obs:
+        t_wait = time.monotonic() + args.tree_timeout
+        while not os.path.exists(primary_obs_file):
+            if child.poll() is not None or time.monotonic() > t_wait:
+                return fail_out(
+                    "primary never published its exporter port"
+                )
+            time.sleep(0.01)
+        with open(primary_obs_file) as f:
+            o_host, o_port = f.read().split()
+        # relays are in THIS process: hand the collector their
+        # exporter objects (loopback fast path), so their identities
+        # are known before the first cycle and component
+        # re-attribution covers the whole run
+        collector = FleetCollector(
+            [f"{o_host}:{o_port}"] + [r.exporter for r in relays],
+            interval_s=0.25, out_path=fleet_path,
+        )
+        collector.start()
 
     def ack_lines() -> list[str]:
         try:
@@ -2061,8 +2154,10 @@ def tree_main(args) -> int:
         d = os.path.join(base, f"leaf{idx}")
         ready = os.path.join(base, f"leaf{idx}.ready")
         result = os.path.join(base, f"leaf{idx}.json")
-        for stale in (ready, result):  # the single-window leaf's dir
-            try:  # is reused (crash-resume); its barrier files not
+        obs_file = os.path.join(base, f"leaf{idx}.obs")
+        for stale in (ready, result,  # the single-window leaf's dir
+                      obs_file):  # is reused (crash-resume); its
+            try:  # barrier/port files not
                 os.remove(stale)
             except FileNotFoundError:
                 pass
@@ -2082,10 +2177,25 @@ def tree_main(args) -> int:
                 "--tree-timeout", str(args.tree_timeout),
                 "--tree-bootstrap", "1" if bootstrap else "0",
                 "--serve-clients", str(clients),
-            ],
-            stdout=child_log, stderr=child_log,
+            ]
+            + (["--obs-port-file", obs_file] if obs else []),
+            stdout=child_log, stderr=child_log, env=child_env,
         )
         return proc, ready, result
+
+    def scrape_leaves(count: int) -> None:
+        """Point the collector at the window's leaf exporters (each
+        spawn publishes a fresh ephemeral port; a dead previous
+        window's target just counts scrape errors)."""
+        if collector is None:
+            return
+        for i in range(count):
+            try:
+                with open(os.path.join(base, f"leaf{i}.obs")) as f:
+                    h, prt = f.read().split()
+            except (FileNotFoundError, ValueError):
+                continue
+            collector.add_target(f"{h}:{prt}")
 
     def run_leaves(count: int, tag: str):
         """Spawn `count` leaves, barrier them on the go file, collect
@@ -2109,6 +2219,7 @@ def tree_main(args) -> int:
                     )
                     return [], 0.0
                 time.sleep(0.02)
+            scrape_leaves(count)
             acks0 = len(ack_lines())
             t0 = time.monotonic()
             with open(go, "w") as f:
@@ -2187,7 +2298,11 @@ def tree_main(args) -> int:
             nr_kwargs=dict(n_replicas=1, log_entries=1 << 15,
                            gc_slack=512, exec_window=256),
             poll_s=0.001, bootstrap=True, name="cold-bootstrap",
+            obs_port=0 if obs else None,
         )
+        if collector is not None:
+            # in-process exporter: the collector's loopback fast path
+            collector.add_target(cold.frontend.exporter)
         if not cold.wait_applied(target, timeout=args.tree_timeout):
             failures.append("bootstrap follower never caught up")
         bootstrap_s = time.perf_counter() - t0
@@ -2380,7 +2495,64 @@ def tree_main(args) -> int:
             "duplicated": duplicated,
             "post_restart_ops": post_ops,
         }
+
+        # ---- --tree-obs gate: the merged fleet trace must let the
+        # report reconstruct at least one sampled record's FULL
+        # submit->ack hop timeline across >= 3 processes, with
+        # per-edge latency percentiles, and the Fleet section must
+        # show every tree node (the observability acceptance of
+        # ISSUE 13 — a fleet you cannot observe is a fleet you
+        # cannot autoscale)
+        if collector is not None:
+            collector.stop()
+            from node_replication_tpu.obs import report as obs_report
+
+            fl = (obs_report.analyze(
+                obs_report.load_events(fleet_path)
+            ).get("fleet")) or {}
+            node_ids = {n.get("node_id")
+                        for n in (fl.get("nodes") or [])}
+            expected = (
+                {"primary", "cold-bootstrap"}
+                | {f"relay{r}" for r in range(n_relays)}
+                | {f"leaf{i}" for i in range(n_leaves)}
+            )
+            missing = sorted(expected - node_ids)
+            if missing:
+                failures.append(
+                    f"fleet section is missing node(s) {missing} "
+                    f"(has {sorted(node_ids)})"
+                )
+            multi = int(fl.get("complete_multiprocess_records", 0))
+            edges = fl.get("edges") or {}
+            if multi < 1:
+                failures.append(
+                    "no sampled record's full submit->ack hop "
+                    "timeline spans >= 3 processes "
+                    f"(records={fl.get('records', 0)}, complete="
+                    f"{fl.get('complete_records', 0)})"
+                )
+            if "submit->ack" not in edges or not edges:
+                failures.append(
+                    "fleet section has no per-edge latency "
+                    f"percentiles (edges={sorted(edges)})"
+                )
+            run.update(
+                obs_nodes=len(node_ids),
+                obs_records=int(fl.get("records", 0)),
+                obs_multiproc_records=multi,
+                obs_edges=len(edges),
+            )
+            print(
+                f"# --tree-obs: {len(node_ids)} node(s), "
+                f"{fl.get('records', 0)} traced record(s), {multi} "
+                f"full multi-process chain(s), {len(edges)} "
+                f"edge(s) -> {fleet_path}",
+                file=sys.stderr,
+            )
     finally:
+        if collector is not None:
+            collector.close()
         for pr in leaf_procs:
             if pr.poll() is None:
                 pr.kill()
@@ -2698,6 +2870,17 @@ def main():
     tree.add_argument("--tree-dir", default=None,
                       help="working directory (default: a temp dir, "
                            "removed after a clean run)")
+    tree.add_argument("--tree-obs", action="store_true",
+                      help="fleet observability on the tree: a "
+                           "metrics exporter in every process, a "
+                           "FleetCollector merging scrapes + trace "
+                           "tails into tree_fleet.jsonl, and a hard "
+                           "gate on a reconstructed cross-process "
+                           "per-record hop timeline (obs/)")
+    tree.add_argument("--tree-obs-sample", type=int, default=4,
+                      help="per-record trace sampling modulus for "
+                           "--tree-obs (keep 1 record in N; default "
+                           "4)")
     tree.add_argument("--tree-follower", action="store_true",
                       help=argparse.SUPPRESS)  # internal: leaf proc
     tree.add_argument("--tree-connect", default=None,
@@ -2716,6 +2899,9 @@ def main():
                       help=argparse.SUPPRESS)  # internal: primary
     tree.add_argument("--tree-min-downstream", type=int, default=1,
                       help=argparse.SUPPRESS)  # internal: ack gate
+    tree.add_argument("--obs-port-file", default=None,
+                      help=argparse.SUPPRESS)  # internal: child
+    # processes publish their exporter address here (--tree-obs)
     args = p.parse_args()
     if args.max_attempts < 1:
         p.error("--max-attempts must be >= 1")

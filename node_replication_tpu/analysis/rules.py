@@ -1676,3 +1676,125 @@ def raw_socket_in_worker(mod: ModuleInfo,
                 f"configure a socket timeout (or an injected-clock "
                 f"deadline) so the loop can observe its stop flag",
             )
+
+
+# --------------------------------------------------------------------------
+# unbounded-metric-cardinality
+# --------------------------------------------------------------------------
+
+#: the registry's instrument factories (obs/metrics.py)
+_METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+
+#: receiver names that denote the metrics registry at a call site
+#: (`reg.counter(...)`, `registry.gauge(...)`, `self._registry...`,
+#: plus the direct `get_registry().counter(...)` chain)
+_REGISTRY_TAILS = frozenset({"reg", "registry", "_reg", "_registry"})
+
+#: identifier shapes that carry PER-RECORD data: log positions,
+#: request/trace/sequence ids. Interpolating one into a metric NAME
+#: mints a new instrument per record. Deliberately absent: `rid`
+#: (replica id — fleet-bounded), `log_idx` (log count), `tid`
+#: excluded? no — a thread-context tid is per-client-thread and
+#: unbounded across a process lifetime, so it matches too.
+_PER_RECORD_TOKENS = re.compile(
+    r"(?:^|_)(?:pos0?|tid|seq(?:no)?|req(?:uest)?(?:_?id)?|"
+    r"op_?id|record|trace_?id)(?:$|\d*$)",
+    re.IGNORECASE,
+)
+
+
+def _is_registry_call(node: ast.Call) -> bool:
+    f = node.func
+    if not (isinstance(f, ast.Attribute)
+            and f.attr in _METRIC_FACTORIES):
+        return False
+    recv = f.value
+    if isinstance(recv, ast.Call):  # get_registry().counter(...)
+        g = recv.func
+        name = g.id if isinstance(g, ast.Name) else (
+            g.attr if isinstance(g, ast.Attribute) else None
+        )
+        return name == "get_registry"
+    tail = _receiver_tail(recv)
+    return tail is not None and tail.lower() in _REGISTRY_TAILS
+
+
+def _interp_exprs(name_arg: ast.AST) -> Iterator[ast.AST]:
+    """Expressions interpolated into a metric-name argument: f-string
+    holes, `.format(...)` arguments, `%` right-hand operands."""
+    if isinstance(name_arg, ast.JoinedStr):
+        for part in name_arg.values:
+            if isinstance(part, ast.FormattedValue):
+                yield part.value
+    elif isinstance(name_arg, ast.Call) and isinstance(
+            name_arg.func, ast.Attribute
+    ) and name_arg.func.attr == "format":
+        yield from name_arg.args
+        for kw in name_arg.keywords:
+            yield kw.value
+    elif isinstance(name_arg, ast.BinOp) and isinstance(
+            name_arg.op, ast.Mod):
+        right = name_arg.right
+        if isinstance(right, ast.Tuple):
+            yield from right.elts
+        else:
+            yield right
+
+
+def _per_record_ident(expr: ast.AST) -> str | None:
+    """The per-record identifier an interpolated expression exposes,
+    or None. Walks the whole expression so `rec.pos`, `self._seq`,
+    and `int(pos0)` all surface their tell-tale name."""
+    for node in ast.walk(expr):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and _PER_RECORD_TOKENS.search(name):
+            return name
+    return None
+
+
+@rule(
+    "unbounded-metric-cardinality", WARNING,
+    "per-record value (pos / request id / seq) interpolated into a "
+    "metric name",
+)
+def unbounded_metric_cardinality(
+        mod: ModuleInfo, project: Project) -> Iterator[Diagnostic]:
+    """The registry's obs discipline (`obs/metrics.py`): instruments
+    are created once and cached; names are a FIXED vocabulary, with at
+    most fleet-bounded dimensions baked in (`serve.queue_depth.r<rid>`
+    — one per replica, retired with the replica). Interpolating
+    per-record data — a log position, a request/trace id, a sequence
+    number — into `counter(f"...{pos}...")` mints a new instrument
+    per record: the registry (and every exporter scrape) grows without
+    bound, which is a memory leak wearing a metrics costume. Emit the
+    per-record value as a trace EVENT field instead (`obs/recorder`,
+    sampled under NR_TPU_TRACE_SAMPLE); keep metric names closed over
+    the code, not the data. Scoped outside obs/ — the registry's own
+    implementation and fixtures legitimately build names from
+    variables."""
+    parts = re.split(r"[\\/]+", mod.path)
+    if "obs" in parts[:-1]:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if not _is_registry_call(node):
+            continue
+        for expr in _interp_exprs(node.args[0]):
+            ident = _per_record_ident(expr)
+            if ident is None:
+                continue
+            kind = node.func.attr
+            yield _diag(
+                mod, node, "unbounded-metric-cardinality",
+                f"`{ident}` interpolated into a {kind}() name mints "
+                f"one instrument per record — the registry (and every "
+                f"exporter scrape) grows without bound; emit it as a "
+                f"trace event field instead and keep metric names a "
+                f"fixed vocabulary",
+            )
+            break

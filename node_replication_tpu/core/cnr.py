@@ -458,6 +458,7 @@ class MultiLogReplicated(_FusedTier):
         self._m_engine_fused.inc()
         self.last_round_tier = "pallas_fused"
         self._tier_by_rid[rid] = "pallas_fused"
+        self._pos_by_rid[rid] = pos0
         return True
 
     @_locked
@@ -515,6 +516,7 @@ class MultiLogReplicated(_FusedTier):
             sp.fence(self.ml, self.states)
         self.last_round_tier = "scan"
         self._tier_by_rid[rid] = "scan"
+        self._pos_by_rid[rid] = pos0
         if timing:
             self._note_fused_sample("chain", pad,
                                     time.perf_counter() - t_chain)

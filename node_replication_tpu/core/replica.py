@@ -249,6 +249,7 @@ class _FusedTier:
         self._fused_rounds = 0
         self.last_round_tier: str | None = None
         self._tier_by_rid: dict[int, str] = {}
+        self._pos_by_rid: dict[int, int] = {}
         self._m_engine_fused = reg.counter(
             f"{prefix}.exec.engine.pallas_fused"
         )
@@ -366,6 +367,15 @@ class _FusedTier:
         batch spanning several logs this is the LAST sub-batch's
         tier."""
         return self._tier_by_rid.get(rid)
+
+    def round_pos(self, rid: int) -> int | None:
+        """The log position replica `rid`'s most recent combiner round
+        appended at (`pos0`) — the per-record trace join key the serve
+        layer stamps onto its `serve-batch` ack event, so a record's
+        submit→ack hop is joinable with the append/ship/apply hops
+        downstream (`obs/` fleet tracing). Same per-rid discipline as
+        `round_tier`."""
+        return self._pos_by_rid.get(rid)
 
     def _fused_tier_state(self) -> str:
         """Human-readable fused-tier state for stats()/snapshot()."""
@@ -1195,6 +1205,7 @@ class NodeReplicated(_FusedTier):
         self._m_engine_fused.inc()
         self.last_round_tier = "pallas_fused"
         self._tier_by_rid[rid] = "pallas_fused"
+        self._pos_by_rid[rid] = pos0
         return True
 
     @_locked
@@ -1269,6 +1280,7 @@ class NodeReplicated(_FusedTier):
             sp.fence(self.log, self.states)
         self.last_round_tier = self.engine
         self._tier_by_rid[rid] = self.engine
+        self._pos_by_rid[rid] = pos0
         if timing:
             # the replay loop's cursor readbacks serialize the chain,
             # so the wall delta is an honest device-time sample

@@ -119,6 +119,7 @@ _TREE_FIELDS = [
     "bootstrap_pos", "bootstrap_s", "full_replay_s",
     "bootstrap_speedup_x", "detect_s", "promote_s", "rto_s",
     "lost", "duplicated", "post_restart_ops",
+    "obs_nodes", "obs_records", "obs_multiproc_records",
 ]
 # One row per crash-recovery measurement (`bench.py --crash`): what
 # the seeded SIGKILL destroyed vs. what recovery restored — fsync-acked
@@ -1523,6 +1524,12 @@ def tree_rows(name: str, run: dict) -> list[dict]:
         "lost": run["lost"],
         "duplicated": run["duplicated"],
         "post_restart_ops": run["post_restart_ops"],
+        # --tree-obs fleet-observability columns (0 when the run had
+        # no exporters; _append_csv's header-mismatch rewrite keeps
+        # pre-obs CSVs aligned)
+        "obs_nodes": run.get("obs_nodes", 0),
+        "obs_records": run.get("obs_records", 0),
+        "obs_multiproc_records": run.get("obs_multiproc_records", 0),
     }]
 
 

@@ -1,13 +1,28 @@
-"""Observability layer: metrics registry + flight recorder + trace report.
+"""Observability layer: metrics, tracing, and the fleet plane.
+
+Single-process half (PR 1 lineage):
 
 - `obs.metrics` — process-wide counters/gauges/histograms
   (`get_registry()`; enable with NR_TPU_METRICS=1).
 - `obs.recorder` — the `Tracer` flight recorder and `span` timing
   context (enable with NR_TPU_TRACE=<path|mem>; fence-accurate spans
-  with NR_TPU_TRACE_FENCE=1). `utils/trace.py` re-exports these for
+  with NR_TPU_TRACE_FENCE=1; per-record sampling with
+  NR_TPU_TRACE_SAMPLE=1/N). `utils/trace.py` re-exports these for
   backward compatibility.
 - `obs.report` — trace-report CLI:
-  `python -m node_replication_tpu.obs.report trace.jsonl`.
+  `python -m node_replication_tpu.obs.report trace.jsonl [--json]`.
+
+Fleet half (multi-process trees, `serve/` + `repl/`):
+
+- `obs.export` — `MetricsExporter`: serve one process's registry
+  snapshot + trace tail on a side port (CRC-framed JSON; Prometheus
+  text via `python -m node_replication_tpu.obs.export --scrape h:p`).
+- `obs.collect` — `FleetCollector`: scrape N exporters into
+  time-series rings + a merged `fleet.jsonl` whose events carry
+  `node_id`/`role`/`t_fleet`; `obs.report`'s Fleet section joins it
+  on `(pos, node_id)` into per-record cross-process hop timelines.
+- `obs.top` — live fleet dashboard:
+  `python -m node_replication_tpu.obs.top --targets h:p1,h:p2`.
 """
 
 from node_replication_tpu.obs.metrics import (
@@ -19,7 +34,14 @@ from node_replication_tpu.obs.metrics import (
     MetricsRegistry,
     get_registry,
 )
-from node_replication_tpu.obs.recorder import Tracer, get_tracer, span
+from node_replication_tpu.obs.recorder import (
+    Tracer,
+    get_tracer,
+    pos_sampled,
+    set_trace_sample,
+    span,
+    trace_sample_n,
+)
 
 __all__ = [
     "COUNT_BUCKETS",
@@ -31,5 +53,8 @@ __all__ = [
     "Tracer",
     "get_registry",
     "get_tracer",
+    "pos_sampled",
+    "set_trace_sample",
     "span",
+    "trace_sample_n",
 ]

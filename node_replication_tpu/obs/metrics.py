@@ -227,6 +227,39 @@ class MetricsRegistry:
             name, lambda: Histogram(name, self, buckets), Histogram
         )
 
+    def remove(self, name: str, instrument=None) -> bool:
+        """Unregister an instrument so it stops appearing in
+        `snapshot()` (and therefore in exporter scrapes). The retire
+        path for per-entity instruments whose entity is gone — e.g. a
+        serve replica's `serve.queue_depth.r<rid>` gauge after
+        failover retires the replica (`ServeFrontend._fail_replica`);
+        without removal every replica ever served haunts the registry
+        forever. A still-cached handle keeps working but writes to a
+        detached instrument; re-creating the name (`gauge(...)` etc.)
+        registers a fresh one.
+
+        Pass `instrument` to make the removal OWNED: the name is only
+        dropped when the registered instrument IS that handle. Names
+        are get-or-create and process-global, so two owners (two
+        frontends serving the same rid in one process) can hold the
+        same gauge — an unconditional remove by the first to retire
+        would silently detach the survivor's live instrument.
+        Returns True when something was removed."""
+        with self._lock:
+            cur = self._metrics.get(name)
+            if cur is None:
+                return False
+            if instrument is not None and cur is not instrument:
+                return False
+            del self._metrics[name]
+            return True
+
+    def names(self) -> list[str]:
+        """Registered instrument names (sorted; includes untouched
+        instruments `snapshot()` would skip)."""
+        with self._lock:
+            return sorted(self._metrics)
+
     def reset(self) -> None:
         """Zero every instrument (names and handles stay registered, so
         cached call-site handles remain valid)."""

@@ -36,6 +36,16 @@ no-op singleton without reading the clock or allocating an event record
 (asserted by tests/test_obs.py). Enable with `NR_TPU_TRACE=<path>`
 (file), `NR_TPU_TRACE=mem` (in-memory; bound it with
 `NR_TPU_TRACE_RING=<n>`), or `get_tracer().enable(...)`.
+
+Per-record sampling (`NR_TPU_TRACE_SAMPLE=1/N` or `=N`): the fleet
+trace plane (`obs/export.py` / `obs/collect.py`) joins events across
+processes on a record's log position `pos`, so per-record hop events
+(repl-ship, relay-forward, repl-apply, ...) must agree on which
+records they narrate. `pos_sampled(pos)` is that agreement: it keeps
+a record iff `pos % N == 0` — a pure function of the position, so
+every process samples the SAME records and a sampled record's chain
+is always complete (never a partial hop sequence), while unsampled
+records are dropped wholesale. N=1 (the default) keeps everything.
 """
 
 from __future__ import annotations
@@ -87,6 +97,10 @@ class Tracer:
         self._lock = threading.Lock()
         self._fh = None
         self._buffer: "collections.deque[dict] | list[dict] | None" = None
+        #: total events ever emitted to the current sink — with
+        #: `len(buffer)` this locates the ring's window in the global
+        #: event sequence (`events_since`, the exporter's cursor)
+        self._emitted = 0
         self.enabled = False
         # fence-accurate span mode (see module docstring); mutable at
         # runtime so tests and notebooks can flip it per section
@@ -111,6 +125,7 @@ class Tracer:
                     collections.deque(maxlen=int(ring))
                     if ring else []
                 )
+            self._emitted = 0
             self.enabled = True
 
     def disable(self) -> None:
@@ -136,13 +151,40 @@ class Tracer:
         with self._lock:
             if self._fh is not None:
                 self._fh.write(json.dumps(rec) + "\n")
+                self._emitted += 1
             elif self._buffer is not None:
                 self._buffer.append(rec)
+                self._emitted += 1
+
+    @property
+    def buffered(self) -> bool:
+        """True in memory/ring mode — the modes `events()`/
+        `events_since()` (and therefore exporter scrapes) can serve
+        from. A file-mode tracer exports nothing: the file is the
+        export."""
+        with self._lock:
+            return self._buffer is not None
 
     def events(self) -> list[dict]:
         """Buffered events (memory/ring mode only), oldest first."""
         with self._lock:
             return list(self._buffer or [])
+
+    def events_since(self, seq: int) -> tuple[int, list[dict]]:
+        """Incremental read of the memory/ring buffer: events the
+        caller has not seen yet, given the cursor `seq` a previous
+        call returned (0 for "from the start"). Returns
+        `(new_cursor, events)`; events evicted by the ring before they
+        were read are simply gone (flight-recorder semantics — the
+        exporter's scrape interval bounds the loss). File-mode tracers
+        return `(cursor, [])`: the file itself is the export."""
+        with self._lock:
+            buf = list(self._buffer or [])
+            total = self._emitted
+        missed = total - int(seq)
+        if missed <= 0:
+            return total, []
+        return total, buf[max(0, len(buf) - missed):]
 
 
 _tracer = Tracer()
@@ -157,6 +199,46 @@ if _env:
 
 def get_tracer() -> Tracer:
     return _tracer
+
+
+def _parse_sample(spec: str | None) -> int:
+    """`"1/N"` or `"N"` -> N (keep one record in N); anything
+    unparsable or < 1 means no sampling (keep all)."""
+    if not spec:
+        return 1
+    s = spec.strip()
+    if "/" in s:
+        s = s.split("/", 1)[1]
+    try:
+        n = int(s)
+    except ValueError:
+        return 1
+    return n if n >= 1 else 1
+
+
+_sample_n = _parse_sample(os.environ.get("NR_TPU_TRACE_SAMPLE"))
+
+
+def trace_sample_n() -> int:
+    """The configured per-record sampling modulus N (1 = keep all)."""
+    return _sample_n
+
+
+def set_trace_sample(n: int) -> None:
+    """Override the sampling modulus at runtime (tests, notebooks)."""
+    global _sample_n
+    _sample_n = max(1, int(n))
+
+
+def pos_sampled(pos: int) -> bool:
+    """Should per-record trace events narrate the record at `pos`?
+
+    Deterministic in the position alone (`pos % N == 0`), so every
+    process in a fleet keeps the SAME records and a sampled record's
+    cross-process hop chain is complete — never partial (module
+    docstring). Callers still guard on `tracer.enabled` first; this
+    only thins the per-record firehose."""
+    return _sample_n <= 1 or int(pos) % _sample_n == 0
 
 
 @contextlib.contextmanager

@@ -40,6 +40,15 @@ Sections:
   tail per second, from `repl-apply` events), and every promotion
   with its measured detect/promote/RTO split (`repl-promote` /
   `repl-rto`).
+- **fleet** (when the trace is a COLLECTOR merge, `obs/collect.py`:
+  events stamped with `node_id`, plus `fleet-scrape` summaries) —
+  the node inventory (role, lag, last scrape), and per-record
+  CROSS-PROCESS hop timelines: events joined on the record's log
+  position `pos` (submit→append→wal-sync→ship→wire→relay-forward→
+  apply, with ack closing the loop), ordered causally and placed on
+  the collector's timeline via each event's `t_fleet` stamp — NEVER
+  by raw `mono`, which does not compare across processes — with
+  per-edge latency p50/p95 aggregated over every sampled record.
 
 Pure stdlib on purpose: on a machine without jax, copy this file next
 to the trace and run it directly (`python report.py trace.jsonl`) —
@@ -97,6 +106,235 @@ def _event_time(e: dict, mono0: float | None,
     if "ts" in e and ts0 is not None:
         return float(e["ts"]) - ts0
     return 0.0
+
+
+# per-record hop chain: causal rank of each hop event in a record's
+# submit→ack life. `serve-batch` expands into BOTH ends (submit at
+# rank 0 reconstructed from its delay fields, ack at the top); ties
+# within a rank order by fleet time.
+_HOP_RANK = {
+    "submit": 0,
+    "append": 1,        # `append` / `fused-round` events (pos0)
+    "wal-sync": 2,      # first sync whose `synced_to` covers pos
+    "ship": 3,          # repl-ship
+    "wire": 4,          # transport-poll (record served downstream)
+    "relay-forward": 5,
+    "apply": 6,         # repl-apply
+    "ack": 7,           # serve-batch (futures resolved)
+}
+_HOP_OF_EVENT = {
+    "append": "append",
+    "fused-round": "append",
+    "repl-ship": "ship",
+    "transport-poll": "wire",
+    "relay-forward": "relay-forward",
+    "repl-apply": "apply",
+}
+
+
+def _analyze_fleet(events: list[dict]) -> dict | None:
+    """The cross-process section: only a COLLECTOR-merged trace
+    (`obs/collect.py`) has it — detected by `node_id`-stamped events
+    and/or `fleet-scrape` summaries. Joins per-record hop events on
+    the record's `pos` across processes; orders them by causal hop
+    rank, then by the collector-aligned `t_fleet` stamp (raw `mono`
+    never compares across processes)."""
+    scrapes = [e for e in events if e.get("event") == "fleet-scrape"]
+    tagged = [e for e in events if e.get("node_id") is not None]
+    if not scrapes and not tagged:
+        return None
+
+    def _t(e):
+        v = e.get("t_fleet", e.get("ts"))
+        return float(v) if v is not None else None
+
+    # ---- node inventory: the LAST scrape summary per node ----------
+    nodes: dict[str, dict] = {}
+    for e in scrapes:
+        nid = str(e.get("node_id", "?"))
+        metrics = e.get("metrics") or {}
+        stats = e.get("stats") or {}
+
+        def _num(d, *path, default=None):
+            cur = d
+            for k in path:
+                if not isinstance(cur, dict) or k not in cur:
+                    return default
+                cur = cur[k]
+            return cur if isinstance(cur, (int, float)) else default
+
+        nodes[nid] = {
+            "node_id": nid,
+            "role": str(e.get("role", "?")),
+            "last_t": e.get("t"),
+            "applied": _num(stats, "follower", "applied",
+                            default=_num(stats, "relay", "cursor")),
+            "ship_lag": _num(metrics, "repl.ship_lag_pos"),
+            "apply_lag": _num(metrics, "repl.apply_lag_pos"),
+            "relay_lag": _num(metrics, "repl.relay.lag_pos"),
+            "completed": _num(stats, "serve", "completed"),
+            "queued": _num(stats, "serve", "queued"),
+            "shed": _num(stats, "serve", "shed"),
+            "scrapes": nodes.get(nid, {}).get("scrapes", 0) + 1,
+        }
+    for e in tagged:  # nodes that emitted events but no summary yet
+        nid = str(e["node_id"])
+        if nid not in nodes:
+            nodes[nid] = {"node_id": nid,
+                          "role": str(e.get("role", "?")),
+                          "scrapes": 0}
+
+    # ---- per-record hop chains keyed by pos ------------------------
+    chains: dict[int, list] = defaultdict(list)
+    syncs_by_node: dict[str, list] = defaultdict(list)
+    for e in tagged:
+        name = e.get("event")
+        nid = str(e["node_id"])
+        t = _t(e)
+        if t is None:
+            continue
+        if name == "wal-sync":
+            syncs_by_node[nid].append(
+                (int(e.get("synced_to", -1)), t)
+            )
+            continue
+        if name == "serve-batch":
+            pos = e.get("pos")
+            if pos is None:
+                continue
+            pos = int(pos)
+            chains[pos].append((_HOP_RANK["ack"], "ack", nid, t))
+            # the submit stamp is reconstructable: the ack event
+            # carries queue delay (admission→assembly) and round
+            # duration (assembly→ack)
+            back = (float(e.get("queue_delay_s", 0.0))
+                    + float(e.get("duration_s", 0.0)))
+            chains[pos].append(
+                (_HOP_RANK["submit"], "submit", nid, t - back)
+            )
+            continue
+        hop = _HOP_OF_EVENT.get(name)
+        if hop is None:
+            continue
+        pos = e.get("pos", e.get("pos0"))
+        if pos is None:
+            continue
+        chains[int(pos)].append((_HOP_RANK[hop], hop, nid, t))
+    # wal-sync joins by coverage: the first sync on the appending
+    # node whose durable boundary passed the record's position
+    for nid in syncs_by_node:
+        syncs_by_node[nid].sort()
+    for pos, hops in chains.items():
+        for nid in {n for _, h, n, _ in hops if h == "append"}:
+            for synced_to, t in syncs_by_node.get(nid, ()):
+                if synced_to > pos:
+                    hops.append(
+                        (_HOP_RANK["wal-sync"], "wal-sync", nid, t)
+                    )
+                    break
+
+    # ---- order, dedup, measure edges -------------------------------
+    timelines = []
+    edge_samples: dict[str, list] = defaultdict(list)
+    for pos in sorted(chains):
+        raw = sorted(chains[pos])
+        # one entry per (hop, node): re-served records (reconnects,
+        # duplicate delivery) re-emit hops; the FIRST occurrence is
+        # the causal one
+        seen = set()
+        hops = []
+        for rank, hop, nid, t in raw:
+            if (hop, nid) in seen:
+                continue
+            seen.add((hop, nid))
+            hops.append({"hop": hop, "node": nid,
+                         "t": round(t, 6)})
+        if not hops:
+            continue
+        # origin discipline: followers replay records through the
+        # SAME combiner protocol the primary used, so every follower
+        # re-emits `append`/`wal-sync` for the record — those are
+        # apply-side details (already narrated by the apply hop), not
+        # the record's origin. Keep append/wal-sync only on the node
+        # that served the submit/ack.
+        origin = next((h["node"] for h in hops
+                       if h["hop"] in ("submit", "ack")), None)
+        if origin is not None:
+            hops = [h for h in hops
+                    if h["hop"] not in ("append", "wal-sync")
+                    or h["node"] == origin]
+        procs = {h["node"] for h in hops}
+        names = [h["hop"] for h in hops]
+        complete = "submit" in names and "ack" in names
+        t0 = hops[0]["t"]
+        # per-edge samples over the CAUSAL path only (submit→...→
+        # apply), between the EARLIEST occurrence of each hop — a hop
+        # can occur on several nodes (two relays forwarding, N
+        # followers applying, a record re-served over a reconnect)
+        # and pairing across those occurrences would manufacture
+        # negative "latencies". Earliest by TIME, not list order: the
+        # hop list is (rank, node)-sorted, so "first in list" would
+        # pick the alphabetically-first node. ack is concurrent with
+        # the downstream hops (ship-before-ack puts it after ship but
+        # racing the relays), so the client-visible edge is measured
+        # separately as submit->ack.
+        first: dict[str, float] = {}
+        for h in hops:
+            if h["hop"] == "ack":
+                continue
+            cur = first.get(h["hop"])
+            if cur is None or h["t"] < cur:
+                first[h["hop"]] = h["t"]
+        labels = sorted(first, key=lambda k: _HOP_RANK[k])
+        for a, b in zip(labels, labels[1:]):
+            edge_samples[f"{a}->{b}"].append(first[b] - first[a])
+        if complete:
+            t_sub = min(h["t"] for h in hops
+                        if h["hop"] == "submit")
+            t_ack = max(h["t"] for h in hops if h["hop"] == "ack")
+            edge_samples["submit->ack"].append(t_ack - t_sub)
+        timelines.append({
+            "pos": pos,
+            "processes": len(procs),
+            "complete": complete,
+            "hops": [{**h, "t": round(h["t"] - t0, 6)}
+                     for h in hops],
+        })
+    edges = {}
+    for label, vals in sorted(edge_samples.items()):
+        vals = sorted(vals)
+        edges[label] = {
+            "count": len(vals),
+            "p50_s": _percentile(vals, 0.50),
+            "p95_s": _percentile(vals, 0.95),
+            "max_s": vals[-1],
+        }
+    complete_multi = [
+        tl for tl in timelines
+        if tl["complete"] and tl["processes"] >= 3
+    ]
+    return {
+        "nodes": [nodes[k] for k in sorted(nodes)],
+        "scrapes": len(scrapes),
+        "scrape_errors": sum(
+            1 for e in events
+            if e.get("event") == "fleet-scrape-error"
+        ),
+        "records": len(timelines),
+        "complete_records": sum(
+            1 for tl in timelines if tl["complete"]
+        ),
+        "complete_multiprocess_records": len(complete_multi),
+        "edges": edges,
+        # the renderable exemplars: widest-spanning complete chains
+        # first (the --json consumer gets every chain's summary via
+        # records/edges; full per-hop dumps stay bounded)
+        "timelines": sorted(
+            timelines,
+            key=lambda tl: (-int(tl["complete"]), -tl["processes"],
+                            tl["pos"]),
+        )[:8],
+    }
 
 
 def analyze(events: list[dict]) -> dict:
@@ -391,6 +629,11 @@ def analyze(events: list[dict]) -> dict:
             "promotions": promotions,
         }
 
+    # fleet section: cross-process merge (obs/collect.py output) —
+    # node inventory from fleet-scrape summaries + per-record hop
+    # timelines joined on (pos, node_id)
+    fleet = _analyze_fleet(events)
+
     # mesh section: placement, rounds by collective tier, collective
     # time, cross-device sync bytes, ring catch-up passes (parallel/)
     mesh = None
@@ -483,6 +726,7 @@ def analyze(events: list[dict]) -> dict:
         "fault": fault,
         "durability": durability,
         "replication": repl,
+        "fleet": fleet,
         "mesh": mesh,
         "kernels": kernels,
         "stalls": [
@@ -499,7 +743,17 @@ def render(report: dict, out=None) -> None:
     # resolve sys.stdout at call time (an import-time default would pin
     # whatever stream was active when the module first loaded)
     w = (out if out is not None else sys.stdout).write
-    w(f"trace: {report['n_events']} events\n")
+    w(f"trace: {report.get('n_events', 0)} events\n")
+    # explicit per-section data statement up front: a section absent
+    # below is absent because the trace holds none of its events, not
+    # because the report crashed on partial data
+    _sections = ("serve", "fault", "durability", "replication",
+                 "fleet", "mesh", "kernels")
+    present = [s for s in _sections if report.get(s)]
+    absent = [s for s in _sections if not report.get(s)]
+    w(f"sections: {', '.join(present) if present else '(core only)'}"
+      + (f"   [no data: {', '.join(absent)}]" if absent else "")
+      + "\n")
 
     w("\n== event counts ==\n")
     for name, n in sorted(report["event_counts"].items(),
@@ -691,6 +945,54 @@ def render(report: dict, out=None) -> None:
               f"({p['drained_records']} drained); detect "
               f"{_fmt_s(p['detect_s'])} + promote "
               f"{_fmt_s(p['promote_s'])} = RTO {_fmt_s(p['rto_s'])}\n")
+
+    fleet = report.get("fleet")
+    if fleet:
+        w("\n== fleet ==\n")
+        nds = fleet.get("nodes") or []
+        if not nds:
+            w("  (no node summaries — events were node-tagged but no "
+              "fleet-scrape lines landed)\n")
+        for nd in nds:
+            parts = [f"{nd.get('node_id', '?'):<18} "
+                     f"role={nd.get('role', '?'):<9}"]
+            for key, label in (("applied", "applied"),
+                               ("ship_lag", "ship-lag"),
+                               ("apply_lag", "apply-lag"),
+                               ("relay_lag", "relay-lag"),
+                               ("completed", "completed"),
+                               ("queued", "queued"),
+                               ("shed", "shed")):
+                v = nd.get(key)
+                if v is not None:
+                    parts.append(f"{label}={v:g}")
+            w("  " + " ".join(parts) + "\n")
+        w(f"  {fleet.get('records', 0)} traced record(s), "
+          f"{fleet.get('complete_records', 0)} with a full "
+          f"submit->ack chain, "
+          f"{fleet.get('complete_multiprocess_records', 0)} spanning "
+          f">=3 processes   ({fleet.get('scrapes', 0)} scrape(s)"
+          + (f", {fleet['scrape_errors']} scrape error(s)"
+             if fleet.get("scrape_errors") else "") + ")\n")
+        edges = fleet.get("edges") or {}
+        if edges:
+            w("  per-edge latency:\n")
+            for label, s in edges.items():
+                w(f"    {label:<24} x{s.get('count', 0):<5} "
+                  f"p50 {_fmt_s(s.get('p50_s', 0.0)):>9} "
+                  f"p95 {_fmt_s(s.get('p95_s', 0.0)):>9} "
+                  f"max {_fmt_s(s.get('max_s', 0.0)):>9}\n")
+        else:
+            w("  (no joinable per-record hops — enable tracing on "
+              "every node and check NR_TPU_TRACE_SAMPLE)\n")
+        for tl in (fleet.get("timelines") or [])[:2]:
+            w(f"  record @pos {tl.get('pos')} "
+              f"({tl.get('processes', 0)} process(es)"
+              + (", complete" if tl.get("complete") else "")
+              + "):\n")
+            for h in tl.get("hops") or []:
+                w(f"    t+{float(h.get('t', 0.0)) * 1e3:9.3f}ms "
+                  f"{h.get('hop', '?'):<14} @{h.get('node', '?')}\n")
 
     mesh = report.get("mesh")
     if mesh:

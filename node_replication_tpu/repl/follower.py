@@ -46,6 +46,7 @@ the acked history ends.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -59,7 +60,7 @@ from node_replication_tpu.repl.feed import FeedGapError
 from node_replication_tpu.serve.errors import StaleRead
 from node_replication_tpu.serve.frontend import ServeConfig, ServeFrontend
 from node_replication_tpu.utils.clock import get_clock
-from node_replication_tpu.utils.trace import get_tracer, span
+from node_replication_tpu.utils.trace import get_tracer, pos_sampled, span
 
 logger = logging.getLogger("node_replication_tpu")
 
@@ -88,6 +89,8 @@ class Follower:
         auto_start: bool = True,
         name: str = "follower",
         bootstrap: bool = True,
+        obs_port: int | None = None,
+        obs_node_id: str | None = None,
     ):
         self.name = name
         self._feed = feed
@@ -134,10 +137,16 @@ class Follower:
         # modes without a WAL, and recover_fleet attached one — so a
         # promoted follower serves the same ack contract the primary
         # did without rebuilding anything
-        self.frontend = ServeFrontend(
-            self.nr, config or ServeConfig(durability="batch"),
-            read_only=True,
-        )
+        cfg = config or ServeConfig(durability="batch")
+        if obs_port is not None:
+            # fleet observability (`obs/export.py`): the follower's
+            # scrape endpoint rides the frontend's exporter knob (one
+            # exporter per node), labeled with the follower's name
+            cfg = dataclasses.replace(cfg, obs_port=obs_port,
+                                      obs_node_id=obs_node_id or name)
+        self.frontend = ServeFrontend(self.nr, cfg, read_only=True)
+        if self.frontend.exporter is not None:
+            self.frontend.exporter.add_stats("follower", self.stats)
 
         reg = get_registry()
         self._m_records = reg.counter("repl.applied_records")
@@ -291,10 +300,14 @@ class Follower:
         self._m_records.inc()
         self._m_ops.inc(len(ops))
         tracer = get_tracer()
-        if tracer.enabled:
+        # per-record hop event, sampled on `pos` like every other hop
+        # (NR_TPU_TRACE_SAMPLE) — a sampled record's apply is always
+        # narrated, an unsampled one never is, on every follower alike
+        if tracer.enabled and pos_sampled(rec.pos):
             tracer.emit("repl-apply", pos=rec.pos, n=len(ops),
                         epoch=rec.epoch, applied=self._applied,
-                        lag=max(0, feed_tail - self._applied))
+                        lag=max(0, feed_tail - self._applied),
+                        name=self.name)
         return True
 
     def _record_failure(self, exc: BaseException) -> None:

@@ -60,7 +60,7 @@ from node_replication_tpu.repl.feed import (
 )
 from node_replication_tpu.repl.transport import FeedServer
 from node_replication_tpu.utils.clock import get_clock
-from node_replication_tpu.utils.trace import get_tracer
+from node_replication_tpu.utils.trace import get_tracer, pos_sampled
 
 logger = logging.getLogger("node_replication_tpu")
 
@@ -90,6 +90,8 @@ class RelayNode:
         health_rid: int = 0,
         auto_start: bool = True,
         name: str = "relay",
+        obs_port: int | None = None,
+        obs_node_id: str | None = None,
     ):
         self.name = name
         self.upstream = upstream
@@ -131,6 +133,21 @@ class RelayNode:
             auto_start=auto_start,
             name=f"{name}-server",
         )
+        #: fleet observability side port (`obs/export.py`): the
+        #: relay's scrape endpoint, serving the process registry plus
+        #: this relay's stats under its own node identity (several
+        #: relays in one process each get their own endpoint). None
+        #: (default) starts nothing — zero added work anywhere.
+        self.exporter = None
+        if obs_port is not None:
+            from node_replication_tpu.obs.export import MetricsExporter
+
+            self.exporter = MetricsExporter(
+                node_id=obs_node_id or name, role="relay",
+                port=obs_port,
+            )
+            self.exporter.add_stats("relay", self.stats)
+
         self._thread = threading.Thread(
             target=self._pump_loop, name=f"repl-relay-{name}",
             daemon=True,
@@ -163,6 +180,8 @@ class RelayNode:
     def close(self) -> None:
         self.stop()
         self.server.close()
+        if self.exporter is not None:
+            self.exporter.close()
         close = getattr(self.upstream, "close", None)
         if close is not None:
             close()
@@ -236,6 +255,13 @@ class RelayNode:
             forwarded += 1
             self._m_forwarded.inc()
             self._m_ops.inc(rec.count)
+            # the record's relay hop (`obs/` fleet tracing): sampled
+            # on `pos` like ship/apply, so a sampled record's chain
+            # includes every relay it crossed — the join that answers
+            # "which relay is the lag bottleneck"
+            if tracer.enabled and pos_sampled(rec.pos):
+                tracer.emit("relay-forward", pos=rec.pos, n=rec.count,
+                            epoch=rec.epoch, name=self.name)
         # the poll response already carried tail + heartbeat: read the
         # transport's cache instead of issuing two more STAT RPCs per
         # pump cycle (at a 1ms poll that would triple every relay's

@@ -40,7 +40,7 @@ import threading
 from node_replication_tpu.fault.inject import fault_hook
 from node_replication_tpu.obs.metrics import get_registry
 from node_replication_tpu.utils.clock import get_clock
-from node_replication_tpu.utils.trace import get_tracer
+from node_replication_tpu.utils.trace import get_tracer, pos_sampled
 
 logger = logging.getLogger("node_replication_tpu")
 
@@ -209,7 +209,11 @@ class ReplicationShipper:
             # WAL's dense int32 framing), so position lag converts
             # exactly
             self._g_lag_bytes.set(lag * 4 * (1 + aw))
-            if tracer.enabled:
+            # per-record hop event, thinned by the fleet sampling
+            # modulus (NR_TPU_TRACE_SAMPLE) so tracing stays
+            # affordable under load; sampling is a pure function of
+            # `pos`, so every process narrates the SAME records
+            if tracer.enabled and pos_sampled(rec.pos):
                 tracer.emit("repl-ship", pos=rec.pos, n=rec.count,
                             epoch=self.epoch, lag=lag)
             self._maybe_heartbeat()

@@ -84,7 +84,7 @@ from node_replication_tpu.repl.feed import (
     MAX_PAYLOAD_BYTES,
 )
 from node_replication_tpu.utils.clock import get_clock
-from node_replication_tpu.utils.trace import get_tracer
+from node_replication_tpu.utils.trace import get_tracer, pos_sampled
 
 logger = logging.getLogger("node_replication_tpu")
 
@@ -552,6 +552,17 @@ class FeedServer:
             out.write(blob)
         if blobs:
             self._m_records.inc(len(blobs))
+            # the record's wire hop (`obs/` fleet tracing): a sampled
+            # record leaving THIS node for a downstream consumer —
+            # sampled on `pos` like ship/forward/apply, so the fleet
+            # report sees which edge a record crossed and when
+            tracer = get_tracer()
+            if tracer.enabled:
+                for rec in records[:len(blobs)]:
+                    if pos_sampled(rec.pos):
+                        tracer.emit("transport-poll", pos=rec.pos,
+                                    n=rec.count, name=self.name,
+                                    conn=cid)
         return out.getvalue()
 
     # --------------------------------------------------------- snapshot

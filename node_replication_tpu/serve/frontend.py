@@ -169,6 +169,15 @@ class ServeConfig:
     overload: OverloadConfig | None = None
     wal_lag_low: int = 1024
     wal_lag_high: int = 8192
+    #: fleet observability (`obs/export.py`): a port (0 = ephemeral)
+    #: starts a `MetricsExporter` serving this process's registry
+    #: snapshot + trace tail + frontend stats on a side socket
+    #: (`frontend.exporter.address`); None (default) starts NOTHING —
+    #: zero added work on any path, not even a branch
+    obs_port: int | None = None
+    #: exporter identity label (defaults to $NR_TPU_NODE_ID or
+    #: `<role>-<pid>`); only read when `obs_port` is set
+    obs_node_id: str | None = None
 
     def __post_init__(self):
         if self.queue_depth < 1:
@@ -612,6 +621,21 @@ class ServeFrontend:
                  self._read_tokens[rid],
                  self._depth_gauges[rid]) = self._new_replica(rid)
                 self._record_device(rid)
+
+        #: fleet observability side port (`ServeConfig.obs_port`,
+        #: `obs/export.py`): the node's scrape endpoint, labeled by
+        #: role — a read-only (follower-mode) frontend announces
+        #: itself as such so the fleet dashboard draws the tree right
+        self.exporter = None
+        if self.cfg.obs_port is not None:
+            from node_replication_tpu.obs.export import MetricsExporter
+
+            self.exporter = MetricsExporter(
+                node_id=self.cfg.obs_node_id,
+                role="follower" if self._read_only else "primary",
+                port=self.cfg.obs_port,
+            )
+            self.exporter.add_stats("serve", self.stats)
         if auto_start:
             self.start()
 
@@ -773,6 +797,18 @@ class ServeFrontend:
                 )
         with self._lock:
             self._rehomed += rehomed
+            gauge = self._depth_gauges.get(rid)
+        # retire the replica's per-rid depth gauge with it: a gauge
+        # for a replica no one serves would haunt every scrape (and
+        # the registry) with its last pre-death value forever;
+        # `restart_replica` re-registers the name on readmission.
+        # Handle-owned removal: after a restart re-registered a fresh
+        # gauge, a straggling retire from the OLD worker must not
+        # remove the live one. (Two co-resident frontends serving the
+        # same rid share the name outright — but then the gauge was
+        # already last-write-wins noise; per-node metrics are
+        # process-grained, obs/export.py docstring.)
+        get_registry().remove(f"serve.queue_depth.r{rid}", gauge)
         if rehomed:
             self._m_rehomed.inc(rehomed)
             get_tracer().emit("serve-rehome", rid=rid, n=rehomed)
@@ -827,6 +863,11 @@ class ServeFrontend:
             )
             self._queues[rid] = q
             self._workers[rid] = t
+            # fresh gauge registration: `_fail_replica` removed the
+            # retired replica's name from the registry
+            self._depth_gauges[rid] = get_registry().gauge(
+                f"serve.queue_depth.r{rid}"
+            )
             del self._failed[rid]
             started = self._started
         get_tracer().emit("serve-replica-restart", rid=rid)
@@ -861,6 +902,7 @@ class ServeFrontend:
             self._closed = True
             queues = list(self._queues.items())
             workers = list(self._workers.values())
+            gauges = dict(self._depth_gauges)
             started = self._started
         leftovers: list[_Request] = []
         for _, q in queues:
@@ -881,6 +923,15 @@ class ServeFrontend:
                 req.future._reject(
                     FrontendClosed("closed before service")
                 )
+        # every served replica retires with the frontend: their
+        # per-rid depth gauges leave the registry (the scrape surface)
+        # instead of reporting a dead frontend's last depths forever
+        # (handle-owned removal — see _fail_replica)
+        reg = get_registry()
+        for rid, _ in queues:
+            reg.remove(f"serve.queue_depth.r{rid}", gauges.get(rid))
+        if self.exporter is not None:
+            self.exporter.close()
         get_tracer().emit("serve-close", drained=drain)
 
     def __enter__(self) -> "ServeFrontend":
@@ -1023,6 +1074,10 @@ class ServeFrontend:
         if not self._read_only:
             return
         self._read_only = False
+        if self.exporter is not None:
+            # the fleet view should see the promotion, not a stale
+            # "follower" label on the node now taking writes
+            self.exporter.role = "primary"
         get_tracer().emit("serve-enable-writes")
 
     def read(self, op: tuple, rid: int = 0,
@@ -1321,9 +1376,20 @@ class ServeFrontend:
             # be overwritten by a concurrent worker's round the way a
             # wrapper-wide field would be.
             tier_of = getattr(self._nr, "round_tier", None)
+            # per-record trace join key (`obs/` fleet tracing): the
+            # log position this batch appended at, read per-rid for
+            # the same reason as the tier. With it the serve-batch
+            # event IS the record's submit→ack hop: `queue_delay_s`
+            # (admission → assembly) + `duration_s` (assembly → ack)
+            # reconstruct the submit time from the ack stamp.
+            pos_of = getattr(self._nr, "round_pos", None)
             tracer.emit(
                 "serve-batch", rid=rid, n=len(live), expired=missed,
                 queue_depth=depth, duration_s=dur,
+                queue_delay_s=max(
+                    0.0, now - min(r.future.t_submit for r in live)
+                ),
+                pos=(pos_of(rid) if pos_of is not None else None),
                 engine=(tier_of(rid) if tier_of is not None
                         else getattr(self._nr, "last_round_tier",
                                      None)),

@@ -12,7 +12,8 @@ get_tracer, span`) working.
 from node_replication_tpu.obs.recorder import (  # noqa: F401
     Tracer,
     get_tracer,
+    pos_sampled,
     span,
 )
 
-__all__ = ["Tracer", "get_tracer", "span"]
+__all__ = ["Tracer", "get_tracer", "pos_sampled", "span"]

@@ -1262,3 +1262,68 @@ class TestAliasedPallasPlanes:
                 )
         """)
         assert not firing(diags2, "aliased-pallas-planes")
+
+
+class TestUnboundedMetricCardinality:
+    def _lint_in(self, tmp_path, subdir, source):
+        import textwrap
+        d = tmp_path / subdir
+        d.mkdir(parents=True, exist_ok=True)
+        p = d / "snippet.py"
+        p.write_text(textwrap.dedent(source))
+        diags, errors = run_lint([str(p)])
+        assert not errors, errors
+        return diags
+
+    def test_pos_and_request_id_interpolation_fires(self, tmp_path):
+        # the leak pattern: one instrument minted per record — the
+        # registry (and every exporter scrape) grows without bound
+        diags = self._lint_in(tmp_path, "serve", """
+            from node_replication_tpu.obs.metrics import get_registry
+
+            def note(reg, rec, request_id):
+                reg.counter(f"repl.record.{rec.pos}").inc()
+                reg.gauge("lat.req.{}".format(request_id)).set(1.0)
+                get_registry().histogram("h.%d" % rec.seq).observe(0.1)
+        """)
+        assert len(firing(diags, "unbounded-metric-cardinality")) == 3
+
+    def test_bounded_dimensions_clean(self, tmp_path):
+        # rid (per-replica) and log_idx (per-log) are fleet-bounded
+        # dimensions — the sanctioned serve.queue_depth.r<rid> shape —
+        # and a constant name is the normal case
+        diags = self._lint_in(tmp_path, "serve", """
+            from node_replication_tpu.obs.metrics import get_registry
+
+            def wire(reg, rid, log_idx):
+                reg.gauge(f"serve.queue_depth.r{rid}").set(0)
+                reg.counter(f"cnr.log{log_idx}.rounds").inc()
+                get_registry().counter("serve.submitted").inc()
+        """)
+        assert not firing(diags, "unbounded-metric-cardinality")
+
+    def test_non_registry_receiver_clean(self, tmp_path):
+        # .counter() on something that is not the metrics registry
+        # (a collections.Counter factory, a stats helper) is out of
+        # scope — the rule keys on registry-shaped receivers
+        diags = self._lint_in(tmp_path, "harness", """
+            def tally(stats, pos):
+                return stats.counter(f"bucket-{pos}")
+        """)
+        assert not firing(diags, "unbounded-metric-cardinality")
+
+    def test_obs_package_out_of_scope(self, tmp_path):
+        # the registry's own implementation/fixtures legitimately
+        # build names from variables
+        diags = self._lint_in(tmp_path, "obs", """
+            def make(reg, pos):
+                return reg.counter(f"fixture.{pos}")
+        """)
+        assert not firing(diags, "unbounded-metric-cardinality")
+
+    def test_suppression_works(self, tmp_path):
+        diags = self._lint_in(tmp_path, "repl", """
+            def note(reg, pos):
+                reg.counter(f"x.{pos}").inc()  # nrlint: disable=unbounded-metric-cardinality — fixture
+        """)
+        assert not firing(diags, "unbounded-metric-cardinality")

@@ -636,3 +636,240 @@ class TestInstrumentedCorrectness:
         finally:
             t.fence_spans = False
             t.disable()
+
+
+class TestRegistryRemove:
+    def test_remove_drops_from_snapshot(self, reg):
+        reg.gauge("serve.queue_depth.r0").set(5)
+        reg.counter("keep").inc()
+        assert "serve.queue_depth.r0" in reg.snapshot()
+        assert reg.remove("serve.queue_depth.r0") is True
+        assert "serve.queue_depth.r0" not in reg.snapshot()
+        assert "serve.queue_depth.r0" not in reg.names()
+        assert reg.remove("serve.queue_depth.r0") is False  # gone
+        assert "keep" in reg.snapshot()
+
+    def test_removed_name_reregisters_fresh(self, reg):
+        g = reg.gauge("g")
+        g.set(7)
+        reg.remove("g")
+        g2 = reg.gauge("g")
+        assert g2 is not g and g2.value == 0.0
+        # the stale cached handle keeps working but is detached
+        g.set(9)
+        assert reg.snapshot().get("g") is None or \
+            reg.snapshot()["g"] == 0.0
+
+
+class TestRetiredReplicaGauges:
+    """The per-rid gauge leak (ISSUE 13 satellite): a replica retired
+    by failover leaves the registry; restart re-registers it; close
+    retires every served replica's gauge."""
+
+    def _frontend(self, global_metrics):
+        from node_replication_tpu.models import make_seqreg
+        from node_replication_tpu.serve import ServeConfig, ServeFrontend
+
+        nr = NodeReplicated(make_seqreg(4), n_replicas=2,
+                            log_entries=512, gc_slack=32,
+                            exec_window=64)
+        fe = ServeFrontend(nr, ServeConfig(batch_linger_s=0.0,
+                                           failover=True))
+        return fe
+
+    def test_failover_retires_gauge_restart_reregisters(
+            self, global_metrics):
+        from node_replication_tpu.fault import FaultPlan, FaultSpec
+        from node_replication_tpu.models import SR_SET
+        from node_replication_tpu.serve import ReplicaFailed
+
+        fe = self._frontend(global_metrics)
+        names = get_registry().names()
+        assert "serve.queue_depth.r0" in names
+        assert "serve.queue_depth.r1" in names
+        plan = FaultPlan([FaultSpec(site="serve-batch",
+                                    action="raise", rid=1, after=0)])
+        with plan.armed():
+            fut = fe.submit((SR_SET, 0, 1), rid=1)
+            with pytest.raises(ReplicaFailed):
+                fut.result(30.0)
+        # the dying worker retires the gauge with the replica
+        deadline = 30.0
+        import time as _time
+        t_end = _time.monotonic() + deadline
+        while ("serve.queue_depth.r1" in get_registry().names()
+               and _time.monotonic() < t_end):
+            _time.sleep(0.01)
+        assert "serve.queue_depth.r1" not in get_registry().names()
+        assert "serve.queue_depth.r0" in get_registry().names()
+        fe.restart_replica(1)
+        assert "serve.queue_depth.r1" in get_registry().names()
+        assert fe.call((SR_SET, 0, 1), rid=1, timeout=30.0) == 0
+        fe.close()
+
+    def test_close_retires_every_served_gauge(self, global_metrics):
+        fe = self._frontend(global_metrics)
+        assert "serve.queue_depth.r0" in get_registry().names()
+        fe.close()
+        names = get_registry().names()
+        assert "serve.queue_depth.r0" not in names
+        assert "serve.queue_depth.r1" not in names
+
+
+class TestRecorderConcurrency:
+    """Ring mode under concurrent writers (ISSUE 13 satellite): 8
+    threads, no torn/interleaved lines, ring keeps the newest N."""
+
+    def test_ring_mode_8_threads_keeps_newest_n(self):
+        t = Tracer()
+        t.enable(None, ring=64)
+        n_threads, per = 8, 100
+
+        def writer(k):
+            for i in range(per):
+                t.emit("w", thread=k, i=i, payload="x" * 20)
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        evs = t.events()
+        assert len(evs) == 64  # the newest N, bound held
+        seq, _ = t.events_since(0)
+        assert seq == n_threads * per  # nothing lost from the count
+        # intact events: every record kept all its fields
+        for e in evs:
+            assert e["event"] == "w"
+            assert set(("ts", "mono", "thread", "i",
+                        "payload")) <= set(e)
+        # newest-N: each thread's surviving events are its LAST ones,
+        # in emit order (no interleaving within a thread)
+        for k in range(n_threads):
+            mine = [e["i"] for e in evs if e["thread"] == k]
+            assert mine == sorted(mine)
+            if mine:
+                assert mine[-1] == per - 1 or len(mine) < per
+        t.disable()
+
+    def test_file_mode_8_threads_no_torn_lines(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        t = Tracer()
+        t.enable(str(path))
+        n_threads, per = 8, 200
+
+        def writer(k):
+            for i in range(per):
+                t.emit("w", thread=k, i=i, payload="y" * 40)
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        t.disable()
+        lines = path.read_text().splitlines()
+        assert len(lines) == n_threads * per
+        per_thread = {k: [] for k in range(n_threads)}
+        for ln in lines:
+            e = json.loads(ln)  # raises on any torn/interleaved line
+            per_thread[e["thread"]].append(e["i"])
+        for k in range(n_threads):
+            assert per_thread[k] == list(range(per))
+
+    def test_events_since_cursor(self):
+        t = Tracer()
+        t.enable(None, ring=4)
+        for i in range(3):
+            t.emit("e", i=i)
+        seq, evs = t.events_since(0)
+        assert seq == 3 and [e["i"] for e in evs] == [0, 1, 2]
+        for i in range(3, 9):
+            t.emit("e", i=i)
+        seq2, evs2 = t.events_since(seq)
+        # 6 new events but the ring holds 4: the evicted two are gone
+        # (flight-recorder semantics), the rest arrive in order
+        assert seq2 == 9 and [e["i"] for e in evs2] == [5, 6, 7, 8]
+        seq3, evs3 = t.events_since(seq2)
+        assert seq3 == 9 and evs3 == []
+        t.disable()
+
+
+class TestSampledTracing:
+    """NR_TPU_TRACE_SAMPLE (ISSUE 13): sampling is a pure function of
+    pos, so a sampled record keeps EVERY hop and an unsampled one
+    keeps none — never a partial chain."""
+
+    def test_pos_sampled_pure_and_modular(self):
+        from node_replication_tpu.obs.recorder import (
+            _parse_sample,
+            pos_sampled,
+            set_trace_sample,
+            trace_sample_n,
+        )
+
+        assert _parse_sample("1/8") == 8
+        assert _parse_sample("8") == 8
+        assert _parse_sample(None) == 1
+        assert _parse_sample("garbage") == 1
+        assert _parse_sample("0") == 1
+        set_trace_sample(4)
+        try:
+            assert trace_sample_n() == 4
+            assert [p for p in range(12) if pos_sampled(p)] == \
+                [0, 4, 8]
+        finally:
+            set_trace_sample(1)
+        assert all(pos_sampled(p) for p in range(5))  # default: all
+
+    def test_ship_apply_chains_whole_or_absent(self, tmp_path):
+        # a real WAL -> shipper -> feed -> follower chain under
+        # sample=1/2: every sampled record appears at BOTH hops,
+        # every unsampled one at neither
+        from node_replication_tpu.durable import WriteAheadLog
+        from node_replication_tpu.models import SR_SET, make_seqreg
+        from node_replication_tpu.obs.recorder import set_trace_sample
+        from node_replication_tpu.repl import (
+            DirectoryFeed,
+            Follower,
+            ReplicationShipper,
+        )
+
+        dispatch = make_seqreg(4)
+        nr = NodeReplicated(dispatch, n_replicas=1, log_entries=512,
+                            gc_slack=32, exec_window=64)
+        wal = WriteAheadLog(str(tmp_path / "wal"), policy="batch")
+        nr.attach_wal(wal)
+        feed = DirectoryFeed(str(tmp_path / "feed"),
+                             arg_width=dispatch.arg_width)
+        t = get_tracer()
+        t.enable(None, ring=4096)
+        set_trace_sample(2)
+        try:
+            tok = nr.register(0)
+            for i in range(1, 9):  # 8 single-op records: pos 0..7
+                nr.execute_mut((SR_SET, i % 4, i), tok)
+            nr.wal_sync()
+            shipper = ReplicationShipper(wal, feed)
+            shipper.barrier(8)
+            f = Follower(dispatch, feed, str(tmp_path / "follower"),
+                         nr_kwargs=dict(n_replicas=1,
+                                        log_entries=512,
+                                        gc_slack=32,
+                                        exec_window=64))
+            assert f.wait_applied(8, timeout=30.0)
+            evs = t.events()
+            ships = {e["pos"] for e in evs
+                     if e["event"] == "repl-ship"}
+            applies = {e["pos"] for e in evs
+                       if e["event"] == "repl-apply"}
+            assert ships == {0, 2, 4, 6}
+            assert applies == ships  # whole chain or nothing
+            f.close()
+            shipper.stop()
+        finally:
+            set_trace_sample(1)
+            t.disable()
+            nr.detach_wal().close()
