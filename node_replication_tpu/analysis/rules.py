@@ -1161,6 +1161,14 @@ _CLOCKED_SUBSYSTEMS = ("serve", "fault", "repl", "durable")
 _RAW_CLOCK_CALLS = {
     "time.monotonic": "time.monotonic() reads the OS clock directly",
     "time.sleep": "time.sleep() blocks on the OS clock directly",
+    # the old blanket perf_counter exemption is narrowed to ops/bench
+    # paths (outside this rule's scope anyway): inside a clock-routed
+    # subsystem even a pure duration probe must follow the injected
+    # clock, or a simulated run's durations (batch times, repair
+    # latencies, fsync spans) are measured against the WRONG clock —
+    # the sim-flavor bug this rule exists to prevent
+    "time.perf_counter": "time.perf_counter() measures against the "
+                         "OS clock directly",
 }
 
 #: receiver tails that denote a threading.Condition in this codebase
@@ -1193,11 +1201,13 @@ def raw_clock_in_subsystem(mod: ModuleInfo,
     in those packages is invisible to the simulator: the component
     would block on (or stamp with) real time mid-simulation, and the
     deterministic-replay property dies silently. `time.perf_counter()`
-    duration probes are exempt (pure intervals, no scheduling), as are
-    `Thread.join` and `Event.wait` (real-thread barriers). The raw
-    clock legitimately lives in `utils/clock.py` itself and in obs/
-    (whose wall/mono stamps are correlation fields) — both outside
-    this rule's path scope."""
+    is flagged too — a duration probe inside a clocked subsystem
+    measures simulated work against the wrong clock (its exemption is
+    narrowed to ops/bench paths, which sit outside this rule's path
+    scope anyway). `Thread.join` and `Event.wait` stay exempt
+    (real-thread barriers). The raw clock legitimately lives in
+    `utils/clock.py` itself and in obs/ (whose wall/mono stamps are
+    correlation fields) — both outside this rule's path scope."""
     sub = _clocked_subsystem(mod.path)
     if sub is None:
         return
@@ -1798,3 +1808,112 @@ def unbounded_metric_cardinality(
                 f"fixed vocabulary",
             )
             break
+
+
+# --------------------------------------------------------------------------
+# device-sync-in-assembly
+# --------------------------------------------------------------------------
+
+#: host-sync calls that would re-serialize the serve pipeline if they
+#: ran on the assembly stage (the whole point of the split is that the
+#: assembly thread never waits on the device or on another round)
+_ASSEMBLY_BLOCKING_DOTTED = {
+    "jax.block_until_ready": "host sync re-serializes the pipeline",
+    "jax.device_get": "device->host transfer re-serializes the "
+                      "pipeline",
+}
+_ASSEMBLY_BLOCKING_METHODS = {
+    "block_until_ready": "host sync re-serializes the pipeline",
+    "item": "device->host scalar readback re-serializes the pipeline",
+    "result": "waiting on a future blocks assembly behind the very "
+              "round it should overlap",
+}
+#: the assembly-stage entry point (`ServeFrontend._assemble`); the
+#: rule roots its transitive closure here
+_ASSEMBLY_ENTRY = "_assemble"
+
+
+def _assembly_functions(mod: ModuleInfo) -> dict[str, ast.AST]:
+    """name -> function node for the assembly-stage call graph: the
+    `_assemble` entry point closed transitively over same-module
+    calls (plain `helper()` and `self._helper()` alike) — the
+    `blocking-in-handler` closure machinery re-rooted at the serve
+    pipeline's assembly stage."""
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    if _ASSEMBLY_ENTRY not in defs:
+        return {}
+    closure: dict[str, ast.AST] = {}
+    queue: list[tuple[str, ast.AST]] = [
+        (_ASSEMBLY_ENTRY, defs[_ASSEMBLY_ENTRY])
+    ]
+    while queue:
+        name, fn = queue.pop()
+        if name in closure:
+            continue
+        closure[name] = fn
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if not isinstance(n, ast.Call):
+                    continue
+                callee = None
+                if isinstance(n.func, ast.Name):
+                    callee = n.func.id
+                elif (
+                    isinstance(n.func, ast.Attribute)
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in ("self", "cls")
+                ):
+                    callee = n.func.attr
+                if callee is not None and callee in defs:
+                    queue.append((callee, defs[callee]))
+    return closure
+
+
+@rule(
+    "device-sync-in-assembly", ERROR,
+    "host-sync / future-wait on the serve pipeline's assembly stage",
+)
+def device_sync_in_assembly(mod: ModuleInfo,
+                            project: Project) -> Iterator[Diagnostic]:
+    """The pipelined serve worker (`ServeFrontend._assemble`,
+    `ServeConfig.pipeline_depth`) exists to overlap round N+1's host
+    work with round N's device work: the assembly stage drains the
+    queue, sweeps deadlines, and `begin_mut_batch`es WITHOUT ever
+    waiting on the device. A `block_until_ready`, `jax.device_get`,
+    `.item()`, or `future.result()` anywhere in the assembly-stage
+    call graph (the `_assemble` entry, closed transitively over
+    same-module helpers like `blocking-in-handler`) silently
+    re-serializes the pipeline — the overlap knob would still read 1
+    while every round pays the full serial latency. Host syncs belong
+    on the completion stage, which is the half DESIGNED to wait."""
+    for name, fn in sorted(_assembly_functions(mod).items()):
+        label = getattr(fn, "name", name)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = mod.dotted(node.func)
+                if d in _ASSEMBLY_BLOCKING_DOTTED:
+                    yield _diag(
+                        mod, node, "device-sync-in-assembly",
+                        f"{label}: {d}() on the assembly stage — "
+                        f"{_ASSEMBLY_BLOCKING_DOTTED[d]}; move the "
+                        f"sync to the completion stage",
+                    )
+                elif (
+                    d is None
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ASSEMBLY_BLOCKING_METHODS
+                ):
+                    yield _diag(
+                        mod, node, "device-sync-in-assembly",
+                        f"{label}: .{node.func.attr}() on the "
+                        f"assembly stage — "
+                        f"{_ASSEMBLY_BLOCKING_METHODS[node.func.attr]}"
+                        f"; move the sync to the completion stage",
+                    )

@@ -41,6 +41,7 @@ from node_replication_tpu.core.replica import (
     ReplicaToken,
     _FusedTier,
     _locked,
+    _PendingRound,
     replicate_state,
     states_equal,
 )
@@ -50,6 +51,22 @@ from node_replication_tpu.ops.encoding import Dispatch, apply_read, encode_ops
 from node_replication_tpu.utils.trace import get_tracer, span
 
 logger = logging.getLogger("node_replication_tpu")
+
+
+class _PendingCnrBatch:
+    """One `begin_mut_batch` batch between begin and finish: the
+    per-log `_PendingRound` sub-rounds (in the log order they were
+    appended) plus the scatter map back to submission indices. The
+    CNR face of the split-round protocol (`core/replica.py:
+    _PendingRound`); NOT atomic across logs, like the serial path."""
+
+    __slots__ = ("rid", "n", "subs")
+
+    def __init__(self, rid: int, n: int):
+        self.rid = rid
+        self.n = n
+        #: list of (log_idx, submission_indices, _PendingRound)
+        self.subs: list[tuple[int, list[int], _PendingRound]] = []
 
 
 class MultiLogReplicated(_FusedTier):
@@ -142,6 +159,10 @@ class MultiLogReplicated(_FusedTier):
         self._inflight: dict[tuple[int, int], deque] = {}
         # delivered responses per thread, in enqueue order per log
         self._resps: dict[tuple[int, int], deque] = {}
+        # split-round registry (`begin_mut_batch`): at most ONE
+        # begun-but-unfinished batch per replica (the NodeReplicated
+        # invariant, here spanning the batch's per-log sub-rounds)
+        self._pending_batch: dict[int, "_PendingCnrBatch"] = {}
         # per-log observability: LogMapper routing counts, combiner
         # passes, replay rounds (+ idle skips) per log
         self._log_selected = [0] * nlogs
@@ -408,11 +429,13 @@ class MultiLogReplicated(_FusedTier):
     @_locked
     def _try_fused_round_log(self, log_idx: int, rid: int, ops, tids,
                              n: int, pos0: int, pad: int,
-                             opcodes, args) -> bool:
+                             opcodes, args, pending=None) -> bool:
         """Route one per-log combiner pass through the fused engine
         when the log is lock-step eligible (the NodeReplicated
         `_try_fused_round` twin, minus fencing/WAL, which CNR does not
-        carry)."""
+        carry). With `pending` (the split-round path) the kernel is
+        launched here and the response readback deferred to
+        `_finish_round_log`."""
         eng = self._fused_tier_wanted(pad)
         if eng is None:
             return False
@@ -436,14 +459,16 @@ class MultiLogReplicated(_FusedTier):
                   and self._fused_choice is None)
         t0 = time.perf_counter()
         fn = self._fused_cnr_round(eng, pad)
+        extra = {"deferred": True} if pending is not None else {}
         with span("fused-round", log=log_idx, rid=rid, n=n, pos0=pos0,
-                  window=pad) as sp:
+                  window=pad, **extra) as sp:
             self.ml, self.states, resps = fn(
                 self.ml, self.states, jnp.int32(log_idx), opcodes,
                 args, n,
             )
-            resps_np = np.asarray(resps)
-            sp.fence(self.ml, self.states)
+            if pending is None:
+                resps_np = np.asarray(resps)
+                sp.fence(self.ml, self.states)
         dt = time.perf_counter() - t0
         if timing:
             self._note_fused_sample("pallas_fused", pad, dt)
@@ -452,27 +477,31 @@ class MultiLogReplicated(_FusedTier):
         # same instrumentation hook (tier counter + kernel.* metrics +
         # kernel-launch event; one contract, never two)
         eng.note_round(pad, n, dt)
-        for j, tid in enumerate(tids):
-            self._resps[(rid, tid)].append(int(resps_np[rid, j]))
         self._fused_rounds += 1
         self._m_engine_fused.inc()
+        if pending is not None:
+            pending.fused_resps = resps
+            return True
+        for j, tid in enumerate(tids):
+            self._resps[(rid, tid)].append(int(resps_np[rid, j]))
         self.last_round_tier = "pallas_fused"
         self._tier_by_rid[rid] = "pallas_fused"
         self._pos_by_rid[rid] = pos0
         return True
 
     @_locked
-    def _append_and_replay_log(self, log_idx: int, rid: int,
-                               ops: list[tuple], tids: list[int],
-                               batch: bool = False) -> None:
-        """Shared per-log combiner-pass tail (`combine` and
-        `execute_mut_batch`'s sub-batches — one protocol, never two):
-        wait for ring space on this log, encode + append, record each
-        op's in-flight response destination, replay the log until
-        replica `rid` has applied its own ops. The lock is reentrant:
-        callers already hold it. Lock-step-eligible passes route
-        through the fused pallas tier when selected
-        (`_try_fused_round_log`) — one kernel launch per sub-batch."""
+    def _begin_round_log(self, log_idx: int, rid: int,
+                         ops: list[tuple], tids: list[int],
+                         batch: bool = False,
+                         defer: bool = False) -> _PendingRound:
+        """First half of the per-log combiner pass (the NodeReplicated
+        `_begin_round` twin): wait for ring space on this log, encode
+        + append, record each op's in-flight response destination.
+        `defer=True` leaves the replay-to-target (or the fused
+        launch's readback) for `_finish_round_log`; calibration rounds
+        ignore `defer` (honest tier timing needs the round
+        back-to-back). The lock is reentrant: callers already hold
+        it."""
         fault_hook("append", rid, self)
         n = len(ops)
         self._combine_rounds[log_idx] += 1
@@ -490,12 +519,20 @@ class MultiLogReplicated(_FusedTier):
         opcodes, args, _ = encode_ops(
             ops, self.spec.arg_width, pad_to=pad
         )
-        if self._try_fused_round_log(log_idx, rid, ops, tids, n, pos0,
-                                     pad, opcodes, args):
-            return
         timing = (self._fused_mode == "auto"
                   and self._fused_choice is None)
-        t_chain = time.perf_counter()
+        defer = defer and not timing
+        pending = _PendingRound(rid, list(tids), n, pos0, batch=batch,
+                                log_idx=log_idx)
+        pending.pad = pad
+        if self._try_fused_round_log(log_idx, rid, ops, tids, n, pos0,
+                                     pad, opcodes, args,
+                                     pending if defer else None):
+            if pending.fused_resps is None:
+                pending.done = True  # ran eagerly end-to-end
+            return pending
+        if timing:
+            pending.t_chain = time.perf_counter()
         extra = {"batch": True} if batch else {}
         with span("append", log=log_idx, rid=rid, n=n, pos0=pos0,
                   **extra) as sp:
@@ -506,7 +543,27 @@ class MultiLogReplicated(_FusedTier):
         infl = self._inflight.setdefault((rid, log_idx), deque())
         for j, tid in enumerate(tids):
             infl.append((pos0 + j, tid))
-        target = pos0 + n
+        return pending
+
+    @_locked
+    def _finish_round_log(self, pending: _PendingRound) -> None:
+        """Second half of the per-log combiner pass: replay this log
+        until replica `rid` has applied its own ops, or read back and
+        deliver the fused launch's responses."""
+        if pending.done:
+            return
+        pending.done = True
+        rid, log_idx = pending.rid, pending.log_idx
+        if pending.fused_resps is not None:
+            resps_np = np.asarray(pending.fused_resps)
+            pending.fused_resps = None
+            for j, tid in enumerate(pending.tids):
+                self._resps[(rid, tid)].append(int(resps_np[rid, j]))
+            self.last_round_tier = "pallas_fused"
+            self._tier_by_rid[rid] = "pallas_fused"
+            self._pos_by_rid[rid] = pending.pos0
+            return
+        target = pending.target
         rounds = 0
         with span("combine-replay", log=log_idx, rid=rid,
                   target=target) as sp:
@@ -516,10 +573,132 @@ class MultiLogReplicated(_FusedTier):
             sp.fence(self.ml, self.states)
         self.last_round_tier = "scan"
         self._tier_by_rid[rid] = "scan"
-        self._pos_by_rid[rid] = pos0
-        if timing:
-            self._note_fused_sample("chain", pad,
-                                    time.perf_counter() - t_chain)
+        self._pos_by_rid[rid] = pending.pos0
+        if pending.t_chain is not None:
+            self._note_fused_sample("chain", pending.pad,
+                                    time.perf_counter()
+                                    - pending.t_chain)
+
+    @_locked
+    def _append_and_replay_log(self, log_idx: int, rid: int,
+                               ops: list[tuple], tids: list[int],
+                               batch: bool = False) -> None:
+        """Shared per-log combiner-pass tail (`combine` and
+        `execute_mut_batch`'s sub-batches — one protocol, never two):
+        `_begin_round_log` + `_finish_round_log` back-to-back; the
+        split-round path (`begin_mut_batch`) runs the same halves
+        spread across the serve pipeline's stages. Lock-step-eligible
+        passes route through the fused pallas tier when selected
+        (`_try_fused_round_log`) — one kernel launch per sub-batch."""
+        self._finish_round_log(
+            self._begin_round_log(log_idx, rid, ops, tids, batch=batch)
+        )
+
+    @_locked
+    def _drop_batch_inflight(self, rid: int) -> None:
+        """Failed-batch hygiene (the NodeReplicated twin): drop every
+        pending BATCH_TID delivery for this replica on every log and
+        clear the sink, so the next batch cannot inherit stale replies
+        (and a short sink cannot wedge every later batch on this
+        replica)."""
+        for key in [(rid, h) for h in range(self.nlogs)
+                    if (rid, h) in self._inflight]:
+            self._inflight[key] = deque(
+                (p, t) for p, t in self._inflight[key]
+                if t != BATCH_TID
+            )
+        sink = self._resps.get((rid, BATCH_TID))
+        if sink is not None:
+            sink.clear()
+
+    @_locked
+    def begin_mut_batch(self, ops: list[tuple],
+                        rid: int = 0) -> "_PendingCnrBatch":
+        """Split-round batch entry, first half (the
+        `NodeReplicated.begin_mut_batch` twin): route each op through
+        the `LogMapper`, then append + journal every per-log sub-batch
+        in log order, deferring each log's replay-to-target to
+        `finish_mut_batch`. At most ONE begun-but-unfinished batch per
+        replica (`RuntimeError` otherwise). NOT atomic across logs —
+        the same per-log contract as `execute_mut_batch`."""
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        if self._pending_batch.get(rid) is not None:
+            raise RuntimeError(
+                f"replica {rid} already has a batch in flight; "
+                f"finish_mut_batch it before beginning another "
+                f"(at most one split round per replica)"
+            )
+        n = len(ops)
+        sink = self._resps.get((rid, BATCH_TID))
+        if sink is None:
+            sink = deque()
+            self._resps[(rid, BATCH_TID)] = sink
+        groups: dict[int, list[int]] = {}
+        for i, op in enumerate(ops):
+            groups.setdefault(self._map(op), []).append(i)
+        max_batch = self.spec.capacity - self.spec.gc_slack
+        for h, idxs in groups.items():
+            if len(idxs) > max_batch:
+                raise LogTooSmallError(
+                    f"log {h}: sub-batch of {len(idxs)} exceeds "
+                    f"appendable capacity {max_batch}"
+                )
+        pend = _PendingCnrBatch(rid, n)
+        try:
+            for h in sorted(groups):
+                idxs = groups[h]
+                sub = self._begin_round_log(
+                    h, rid, [ops[i] for i in idxs],
+                    [BATCH_TID] * len(idxs), batch=True, defer=True,
+                )
+                pend.subs.append((h, idxs, sub))
+        except BaseException:
+            self._drop_batch_inflight(rid)
+            raise
+        self._pending_batch[rid] = pend
+        return pend
+
+    @_locked
+    def finish_mut_batch(self, pend: "_PendingCnrBatch") -> list:
+        """Split-round batch entry, second half: replay every per-log
+        sub-round to its target (in the same log order `begin`
+        appended), scatter responses back to submission indices,
+        release the replica's in-flight slot."""
+        rid = pend.rid
+        if self._pending_batch.get(rid) is not pend:
+            raise RuntimeError(
+                f"pending batch for replica {rid} is not this "
+                f"replica's in-flight batch (already finished?)"
+            )
+        sink = self._resps[(rid, BATCH_TID)]
+        out: list = [None] * pend.n
+        try:
+            for h, idxs, sub in pend.subs:
+                self._finish_round_log(sub)
+                assert len(sink) == len(idxs), (len(sink), len(idxs))
+                for i in idxs:
+                    out[i] = sink.popleft()
+            return out
+        except BaseException:
+            self._drop_batch_inflight(rid)
+            raise
+        finally:
+            self._pending_batch.pop(rid, None)
+
+    @_locked
+    def abort_mut_batch(self, pend: "_PendingCnrBatch") -> None:
+        """Abandon a begun-but-unfinished split batch (the
+        `NodeReplicated.abort_mut_batch` twin): every appended sub-
+        batch WILL replay; only response delivery drops. Idempotent."""
+        rid = pend.rid
+        if self._pending_batch.get(rid) is not pend:
+            return
+        self._pending_batch.pop(rid, None)
+        for _, _, sub in pend.subs:
+            sub.done = True
+            sub.fused_resps = None
+        self._drop_batch_inflight(rid)
 
     @_locked
     def execute_mut_batch(self, ops: list[tuple],
@@ -527,18 +706,34 @@ class MultiLogReplicated(_FusedTier):
         """Execute a caller-assembled batch as one combiner pass PER
         MAPPED LOG and return responses in submission order — the CNR
         twin of `NodeReplicated.execute_mut_batch` (the serve
-        frontend's entry point).
+        frontend's serial entry point).
 
         Each op routes through the `LogMapper` exactly as `execute_mut`
         would (`cnr/src/replica.rs:435`); the batch then splits into
         per-log sub-batches that append and replay one log at a time,
-        in log order. Responses come back through a dedicated deque
-        sink keyed `(rid, BATCH_TID)` and are scattered back to the
-        callers' submission indices, so interleaving with per-thread
+        in log order (each pass is `_begin_round_log` +
+        `_finish_round_log` back-to-back, the same halves the
+        split-round path runs). A failure during log `h`'s pass
+        therefore leaves later logs' sub-batches UNappended — the
+        historical serial contract — whereas the split path
+        (`begin_mut_batch`) appends every sub-batch up front so the
+        whole batch shares one post-append failure class. Responses
+        come back through a dedicated deque sink keyed
+        `(rid, BATCH_TID)` and are scattered back to the callers'
+        submission indices, so interleaving with per-thread
         `execute_mut` traffic on the same replica stays ordered.
         """
         if not 0 <= rid < self.n_replicas:
             raise ValueError(f"replica {rid} out of range")
+        if self._pending_batch.get(rid) is not None:
+            # the NodeReplicated guard (there via begin_mut_batch): a
+            # serial batch interleaved with a begun split batch would
+            # deliver the split batch's appended entries into the
+            # shared BATCH_TID sink and scatter wrong responses
+            raise RuntimeError(
+                f"replica {rid} already has a batch in flight; "
+                f"finish_mut_batch it before executing another"
+            )
         n = len(ops)
         if n == 0:
             return []
@@ -570,18 +765,7 @@ class MultiLogReplicated(_FusedTier):
                     out[i] = sink.popleft()
             return out
         except BaseException:
-            # failed-batch hygiene (the NodeReplicated twin): drop
-            # every pending BATCH_TID delivery for this replica and
-            # clear the sink, so the next batch cannot inherit stale
-            # replies (and a short sink cannot wedge every later
-            # batch on this replica)
-            for key in [(rid, h) for h in groups
-                        if (rid, h) in self._inflight]:
-                self._inflight[key] = deque(
-                    (p, t) for p, t in self._inflight[key]
-                    if t != BATCH_TID
-                )
-            sink.clear()
+            self._drop_batch_inflight(rid)
             raise
 
     @_locked

@@ -130,6 +130,42 @@ class _BatchSink:
         self._inflight = 0
 
 
+class _PendingRound:
+    """One combiner round between `begin` and `finish` — the split
+    round protocol behind `begin_mut_batch`/`finish_mut_batch` (and
+    the serve pipeline's assembly/completion overlap,
+    `serve/frontend.py`).
+
+    After `begin` the batch is APPENDED: the ops are in the in-memory
+    log (and the WAL, when one is attached), so a failure from here on
+    is post-append (`maybe_executed` semantics). What `begin` defers
+    is only this replica's replay-to-target (chain tier) or the
+    response readback (fused tier — the kernel is already launched and
+    running on the device); `finish` completes it. `done` marks a
+    round that `begin` ran eagerly end-to-end (serial callers,
+    calibration rounds, empty batches) so `finish` only collects.
+    `log_idx` is the CNR per-log variant's mapped log (None for NR).
+    """
+
+    __slots__ = ("rid", "tids", "n", "pos0", "target", "batch",
+                 "log_idx", "fused_resps", "done", "t_chain", "pad")
+
+    def __init__(self, rid: int, tids: list[int], n: int, pos0: int,
+                 batch: bool = False, log_idx: int | None = None):
+        self.rid = rid
+        self.tids = tids
+        self.n = n
+        self.pos0 = pos0
+        self.target = pos0 + n
+        self.batch = batch
+        self.log_idx = log_idx
+        #: device array of the fused launch awaiting readback
+        self.fused_resps = None
+        self.done = False
+        self.t_chain: float | None = None
+        self.pad = 0
+
+
 class ReplicaToken(NamedTuple):
     """Registration handle (`ReplicaToken`, `nr/src/replica.rs:27-30`).
 
@@ -444,6 +480,11 @@ class NodeReplicated(_FusedTier):
         self._threads_per_replica = [0] * n_replicas
         # Appended-but-unanswered ops per replica: deque[(logical_pos, tid)].
         self._inflight: list[deque] = [deque() for _ in range(n_replicas)]
+        # Split-round registry (`begin_mut_batch`): at most ONE
+        # begun-but-unfinished round per replica — the pipeline-depth-1
+        # invariant that keeps future ordering, `maybe_executed`
+        # attribution, and WAL group-commit per-round.
+        self._pending_batch: dict[int, "_PendingRound"] = {}
         # Quarantine mask (`fault/health.py`): None until the first
         # `fence_replica` so the no-fault hot path stays byte-identical
         # (the compiled programs never see a mask argument); a bool[R]
@@ -866,6 +907,13 @@ class NodeReplicated(_FusedTier):
         sink = self._contexts.get((rid, BATCH_TID))
         if sink is not None:
             sink.reset()
+        # crash semantics for a begun-but-unfinished split round too:
+        # its delivery state is gone with the sink, and a repaired
+        # replica must be able to begin fresh rounds
+        stale = self._pending_batch.pop(rid, None)
+        if stale is not None:
+            stale.done = True
+            stale.fused_resps = None
         get_tracer().emit(
             "fault-fence", rid=rid,
             ltail=int(np.asarray(self.log.ltails)[rid]),
@@ -1138,7 +1186,7 @@ class NodeReplicated(_FusedTier):
 
     @_locked
     def _try_fused_round(self, ops, rid, tids, n, pos0, pad,
-                         opcodes, args) -> bool:
+                         opcodes, args, pending=None) -> bool:
         """Route one combiner round through the fused engine when
         eligible; False falls back to the append+exec chain. The
         eligibility is exactly the lock-step precondition the fused
@@ -1147,7 +1195,13 @@ class NodeReplicated(_FusedTier):
         in-flight responses owed (the fused round delivers only its
         own batch), and a window the engine's ring-span append
         supports. Results are bit-identical to the chain either way;
-        only launch count and latency differ."""
+        only launch count and latency differ.
+
+        With `pending` (a `_PendingRound` — the split-round path), the
+        kernel is LAUNCHED and journaled here but the response
+        readback (the round's host fence) is deferred to
+        `_finish_round`: the whole device round overlaps whatever host
+        work the caller does between begin and finish."""
         eng = self._fused_tier_wanted(pad)
         if eng is None:
             return False
@@ -1174,16 +1228,18 @@ class NodeReplicated(_FusedTier):
                   and self._fused_choice is None)
         t0 = time.perf_counter()
         fenced = self._fenced
+        extra = {"deferred": True} if pending is not None else {}
         with span("fused-round", rid=rid, n=n, pos0=pos0,
-                  window=pad) as sp:
+                  window=pad, **extra) as sp:
             self.log, self.states, resps = eng.round(
                 self.log, self.states, opcodes, args, n, fenced=fenced
             )
-            # the response readback is also the round's host fence:
-            # delivery below needs the values, and the calibration
-            # timing needs completed device work
-            resps_np = np.asarray(resps)
-            sp.fence(self.log, self.states)
+            if pending is None:
+                # the response readback is also the round's host
+                # fence: delivery below needs the values, and the
+                # calibration timing needs completed device work
+                resps_np = np.asarray(resps)
+                sp.fence(self.log, self.states)
         if timing:
             self._note_fused_sample(
                 "pallas_fused", pad, time.perf_counter() - t0
@@ -1197,33 +1253,48 @@ class NodeReplicated(_FusedTier):
             else:
                 floor = min(int(lts[fenced].min()), pos0 + n)
             self._wal.maybe_reclaim(floor)
+        self._fused_rounds += 1
+        self._m_engine_fused.inc()
+        if pending is not None:
+            # split round: the launch is in flight; `_finish_round`
+            # reads the responses back and delivers
+            pending.fused_resps = resps
+            return True
         for j, tid in enumerate(tids):
             self._contexts[(rid, tid)].enqueue_resps(
                 [int(resps_np[rid, j])]
             )
-        self._fused_rounds += 1
-        self._m_engine_fused.inc()
         self.last_round_tier = "pallas_fused"
         self._tier_by_rid[rid] = "pallas_fused"
         self._pos_by_rid[rid] = pos0
         return True
 
     @_locked
-    def _append_and_replay(self, ops: list[tuple], rid: int,
-                           tids: list[int], batch: bool = False) -> None:
-        """Shared combiner-round tail (one protocol, every caller):
+    def _begin_round(self, ops: list[tuple], rid: int,
+                     tids: list[int], batch: bool = False,
+                     defer: bool = False) -> _PendingRound:
+        """First half of the shared combiner-round protocol (one
+        protocol, every caller): fence guard, append-site fault hook,
         wait for ring space (helping GC), encode + append the batch,
-        record each op's in-flight response destination, and replay
-        until replica `rid` has applied its own ops. `combine`,
-        `execute_mut_batch`, and nothing else — serve-path and
-        thread-context rounds must never diverge. The lock is
-        reentrant: callers already hold it.
+        journal it, record each op's in-flight response destination.
+        Returns the `_PendingRound` that `_finish_round` completes.
+
+        `defer=False` is the serial shape: the caller runs
+        `_finish_round` immediately (that composition IS
+        `_append_and_replay`). `defer=True` (the split-round path,
+        `begin_mut_batch`) leaves this replica's replay-to-target —
+        or, on the fused tier, the response readback of the
+        already-launched kernel — for `finish`, so a pipelined caller
+        overlaps the next batch's host work with this round's device
+        work. Calibration rounds (`engine='auto'`, verdict pending)
+        ignore `defer`: honest tier timing needs the round
+        back-to-back. The lock is reentrant: callers already hold it.
 
         When the fused pallas tier is selected and the round is
-        lock-step eligible, the whole tail — append, replay, response
-        gather — runs as ONE kernel launch instead
-        (`_try_fused_round`); the WAL journaling, response-delivery
-        order, and cursor lattice are identical by construction."""
+        lock-step eligible, the whole round — append, replay, response
+        gather — is ONE kernel launch (`_try_fused_round`); the WAL
+        journaling, response-delivery order, and cursor lattice are
+        identical by construction."""
         if self._is_fenced(rid):
             # a fenced replica's replay is frozen: waiting for it to
             # apply its own batch would hang forever — fail fast, the
@@ -1247,12 +1318,19 @@ class NodeReplicated(_FusedTier):
         opcodes, args, _ = encode_ops(
             ops, self.spec.arg_width, pad_to=pad
         )
-        if self._try_fused_round(ops, rid, tids, n, pos0, pad,
-                                 opcodes, args):
-            return
         timing = (self._fused_mode == "auto"
                   and self._fused_choice is None)
-        t_chain = time.perf_counter()
+        defer = defer and not timing
+        pending = _PendingRound(rid, list(tids), n, pos0, batch=batch)
+        pending.pad = pad
+        if self._try_fused_round(ops, rid, tids, n, pos0, pad,
+                                 opcodes, args,
+                                 pending if defer else None):
+            if pending.fused_resps is None:
+                pending.done = True  # ran eagerly end-to-end
+            return pending
+        if timing:
+            pending.t_chain = time.perf_counter()
         extra = {"batch": True} if batch else {}
         with span("append", rid=rid, n=n, pos0=pos0, **extra) as sp:
             self.log = self._append_call(opcodes, args, n)
@@ -1270,8 +1348,40 @@ class NodeReplicated(_FusedTier):
         inflight = self._inflight[rid]
         for j, tid in enumerate(tids):
             inflight.append((pos0 + j, tid))
+        return pending
 
-        target = pos0 + n
+    @_locked
+    def _finish_round(self, pending: _PendingRound) -> None:
+        """Second half of the combiner-round protocol: replay until
+        replica `rid` has applied its own ops (chain tier), or read
+        back and deliver the fused launch's responses. No-op for a
+        round `begin` already completed eagerly."""
+        if pending.done:
+            return
+        pending.done = True
+        rid = pending.rid
+        if self._is_fenced(rid):
+            # fenced between begin and finish (failover quarantine):
+            # the chain replay cursor is frozen — waiting on it would
+            # hang — and `fence_replica` dropped the in-flight
+            # deliveries with crash semantics, so a computed fused
+            # round's responses are equally undeliverable. Post-append
+            # by construction: maybe_executed semantics.
+            raise ReplicaFencedError(rid)
+        if pending.fused_resps is not None:
+            # the readback is the split round's host fence: the fused
+            # launch (append+replay+gather) completes here
+            resps_np = np.asarray(pending.fused_resps)
+            pending.fused_resps = None
+            for j, tid in enumerate(pending.tids):
+                self._contexts[(rid, tid)].enqueue_resps(
+                    [int(resps_np[rid, j])]
+                )
+            self.last_round_tier = "pallas_fused"
+            self._tier_by_rid[rid] = "pallas_fused"
+            self._pos_by_rid[rid] = pending.pos0
+            return
+        target = pending.target
         rounds = 0
         with span("combine-replay", rid=rid, target=target) as sp:
             while int(np.asarray(self.log.ltails)[rid]) < target:
@@ -1280,12 +1390,139 @@ class NodeReplicated(_FusedTier):
             sp.fence(self.log, self.states)
         self.last_round_tier = self.engine
         self._tier_by_rid[rid] = self.engine
-        self._pos_by_rid[rid] = pos0
-        if timing:
+        self._pos_by_rid[rid] = pending.pos0
+        if pending.t_chain is not None:
             # the replay loop's cursor readbacks serialize the chain,
             # so the wall delta is an honest device-time sample
-            self._note_fused_sample("chain", pad,
-                                    time.perf_counter() - t_chain)
+            self._note_fused_sample("chain", pending.pad,
+                                    time.perf_counter()
+                                    - pending.t_chain)
+
+    @_locked
+    def _append_and_replay(self, ops: list[tuple], rid: int,
+                           tids: list[int], batch: bool = False) -> None:
+        """Shared combiner-round tail (one protocol, every caller):
+        `_begin_round` + `_finish_round` back-to-back. `combine`, the
+        batch entry points, and nothing else — serve-path,
+        split-round, and thread-context rounds cannot diverge because
+        they all run this composition (the serve pipeline merely
+        spreads the two halves across its stages)."""
+        self._finish_round(
+            self._begin_round(ops, rid, tids, batch=batch)
+        )
+
+    @_locked
+    def _drop_batch_inflight(self, rid: int) -> None:
+        """Failed-batch hygiene: appended ops stay in the log (they
+        WILL replay — the log is the source of truth), but their
+        responses are undeliverable. Drop this batch's pending
+        deliveries and reset the sink so the NEXT batch's responses
+        cannot be prefixed with stale replies."""
+        self._inflight[rid] = deque(
+            (p, t) for p, t in self._inflight[rid]
+            if t != BATCH_TID
+        )
+        self._contexts[(rid, BATCH_TID)].reset()
+
+    @_locked
+    def begin_mut_batch(self, ops: list[tuple],
+                        rid: int = 0) -> _PendingRound:
+        """Split-round batch entry, first half (the serve pipeline's
+        assembly stage, `serve/frontend.py`): GC-wait, encode, append,
+        journal — everything up to (not including) this replica's
+        replay-to-target, which `finish_mut_batch` completes. On the
+        fused tier the kernel (append+replay+response gather in one
+        launch) is already ISSUED when this returns; only the readback
+        waits — so the whole device round overlaps whatever host work
+        the caller does before `finish`.
+
+        At most ONE begun-but-unfinished round per replica
+        (`RuntimeError` otherwise): a second in-flight round would
+        interleave response delivery and make post-append failure
+        attribution (`maybe_executed`) ambiguous — that invariant is
+        why the serve pipeline's overlap depth is capped at 1.
+
+        Failure semantics: a raise out of `begin` is pre-append only
+        when it is the fence guard or an append-site injection
+        (`FaultError(site='append')`) — both fire before the batch
+        reaches the log; anything later (WAL journal failure) is
+        post-append. A raise out of `finish` is always post-append:
+        the ops are in the log and WILL replay, only responses are
+        lost."""
+        if not 0 <= rid < self.n_replicas:
+            raise ValueError(f"replica {rid} out of range")
+        if self._pending_batch.get(rid) is not None:
+            raise RuntimeError(
+                f"replica {rid} already has a round in flight; "
+                f"finish_mut_batch it before beginning another "
+                f"(at most one split round per replica)"
+            )
+        n = len(ops)
+        sink = self._contexts.get((rid, BATCH_TID))
+        if sink is None:
+            sink = _BatchSink()
+            self._contexts[(rid, BATCH_TID)] = sink
+        if n == 0:
+            pending = _PendingRound(rid, [], 0, int(self.log.tail),
+                                    batch=True)
+            pending.done = True
+            self._pending_batch[rid] = pending
+            return pending
+        sink.expect(n)
+        try:
+            pending = self._begin_round(
+                list(ops), rid, [BATCH_TID] * n, batch=True,
+                defer=True,
+            )
+        except BaseException:
+            self._drop_batch_inflight(rid)
+            raise
+        self._pending_batch[rid] = pending
+        return pending
+
+    @_locked
+    def finish_mut_batch(self, pending: _PendingRound) -> list:
+        """Split-round batch entry, second half (the serve pipeline's
+        completion stage): replay to the round's target (or read back
+        the fused launch), collect the responses, release the
+        replica's in-flight slot. Responses come back in op order.
+        `pending` must be the replica's registered in-flight round
+        (`begin_mut_batch`'s return value, finished exactly once)."""
+        rid = pending.rid
+        if self._pending_batch.get(rid) is not pending:
+            raise RuntimeError(
+                f"pending round for replica {rid} is not this "
+                f"replica's in-flight round (already finished?)"
+            )
+        sink = self._contexts[(rid, BATCH_TID)]
+        try:
+            self._finish_round(pending)
+            resps = sink.take()
+            assert len(resps) == pending.n, (len(resps), pending.n)
+            return resps
+        except BaseException:
+            self._drop_batch_inflight(rid)
+            raise
+        finally:
+            self._pending_batch.pop(rid, None)
+
+    @_locked
+    def abort_mut_batch(self, pending: _PendingRound) -> None:
+        """Abandon a begun-but-unfinished split round (the serve
+        pipeline's failover teardown): its ops are in the log — they
+        WILL replay, the log is the source of truth — but their
+        responses are undeliverable, so the batch's pending deliveries
+        drop (`_drop_batch_inflight`) and the replica's in-flight slot
+        releases. Idempotent; a no-op for a round already finished or
+        already torn down (e.g. by `fence_replica`'s crash
+        semantics)."""
+        rid = pending.rid
+        if self._pending_batch.get(rid) is not pending:
+            return
+        self._pending_batch.pop(rid, None)
+        pending.done = True
+        pending.fused_resps = None
+        self._drop_batch_inflight(rid)
 
     @_locked
     def execute_mut_batch(self, ops: list[tuple],
@@ -1293,49 +1530,24 @@ class NodeReplicated(_FusedTier):
         """Execute a caller-assembled batch of write ops as ONE
         flat-combining round and return their responses in op order.
 
-        The serve frontend's entry point (`serve/frontend.py`): the
-        frontend's worker already holds a whole batch, so routing it
-        through per-thread 32-slot contexts would just re-chunk it.
-        This appends the batch directly — one `encode_ops` + one
-        append + one replay-to-target pass, sharing the combiner lock,
-        GC helping loop, and response-delivery machinery with
-        `combine` — and collects responses through a dedicated
-        `_BatchSink` keyed `(rid, BATCH_TID)` so concurrent per-thread
-        contexts on the same replica keep their own deliveries.
+        The serve frontend's serial entry point (`serve/frontend.py`):
+        the frontend's worker already holds a whole batch, so routing
+        it through per-thread 32-slot contexts would just re-chunk it.
+        This IS `begin_mut_batch` + `finish_mut_batch` back-to-back
+        under one lock hold — the split-round protocol and the serial
+        path cannot diverge because the serial path is the
+        composition. One `encode_ops` + one append + one
+        replay-to-target pass, sharing the combiner lock, GC helping
+        loop, and response-delivery machinery with `combine`;
+        responses collect through a dedicated `_BatchSink` keyed
+        `(rid, BATCH_TID)` so concurrent per-thread contexts on the
+        same replica keep their own deliveries.
 
         Interleaving with `execute_mut`/`enqueue_mut` from other OS
         threads is safe: the reentrant lock serializes rounds, and the
         shared `_inflight` deque orders deliveries by log position.
         """
-        if not 0 <= rid < self.n_replicas:
-            raise ValueError(f"replica {rid} out of range")
-        n = len(ops)
-        if n == 0:
-            return []
-        sink = self._contexts.get((rid, BATCH_TID))
-        if sink is None:
-            sink = _BatchSink()
-            self._contexts[(rid, BATCH_TID)] = sink
-        try:
-            sink.expect(n)
-            self._append_and_replay(
-                list(ops), rid, [BATCH_TID] * n, batch=True
-            )
-            resps = sink.take()
-            assert len(resps) == n, (len(resps), n)
-            return resps
-        except BaseException:
-            # failed-batch hygiene: appended ops stay in the log (they
-            # WILL replay — the log is the source of truth), but their
-            # responses are undeliverable. Drop this batch's pending
-            # deliveries and reset the sink so the NEXT batch's
-            # responses cannot be prefixed with stale replies.
-            self._inflight[rid] = deque(
-                (p, t) for p, t in self._inflight[rid]
-                if t != BATCH_TID
-            )
-            sink.reset()
-            raise
+        return self.finish_mut_batch(self.begin_mut_batch(ops, rid))
 
     @_locked
     def sync(self, rid: int | None = None) -> None:
@@ -1497,6 +1709,10 @@ class NodeReplicated(_FusedTier):
         )
         self._place_on_mesh()  # rebuilt states: restore the shardings
         self._inflight = [deque() for _ in range(self.n_replicas)]
+        # crash semantics: begun-but-unfinished split rounds die with
+        # the rebuild (their ops are in the log and replayed; the
+        # responses are gone, like every other in-flight delivery)
+        self._pending_batch.clear()
         # full-fleet rebuild: every replica is freshly consistent, so
         # any quarantine fencing is moot
         self._fenced = None

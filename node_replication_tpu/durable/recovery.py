@@ -36,7 +36,6 @@ from __future__ import annotations
 import dataclasses
 import os
 import re
-import time
 
 import numpy as np
 
@@ -48,6 +47,7 @@ from node_replication_tpu.core.checkpoint import (
 from node_replication_tpu.core.replica import NodeReplicated
 from node_replication_tpu.durable.wal import WalError, WriteAheadLog
 from node_replication_tpu.obs.metrics import get_registry
+from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer, span
 
 _SNAP_RE = re.compile(r"^snap-(\d{20})\.npz$")
@@ -132,7 +132,7 @@ def recover_fleet(
     The returned instance has the reopened WAL attached at its tail,
     so serving can resume immediately (`ServeFrontend.from_recovery`).
     """
-    t0 = time.perf_counter()
+    t0 = get_clock().now()
     kw = dict(nr_kwargs or {})
     os.makedirs(directory, exist_ok=True)
     skipped: list = []
@@ -198,7 +198,7 @@ def recover_fleet(
         # was ahead of the WAL (policy `none`, lost unsynced tail)
     else:
         wal.close()
-    dur = time.perf_counter() - t0
+    dur = get_clock().now() - t0
     reg = get_registry()
     reg.counter("recovery.runs").inc()
     reg.counter("wal.replayed").inc(ops_replayed)

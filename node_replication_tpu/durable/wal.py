@@ -72,7 +72,6 @@ import os
 import re
 import struct
 import threading
-import time
 import zlib
 from typing import Iterator, Sequence
 
@@ -80,6 +79,7 @@ import numpy as np
 
 from node_replication_tpu.fault.inject import fault_hook
 from node_replication_tpu.obs.metrics import get_registry
+from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer
 
 _MAGIC = b"NRWAL001"
@@ -497,11 +497,12 @@ class WriteAheadLog:
 
     def _fsync_locked(self) -> None:
         fault_hook("wal-fsync", -1, self)
-        # perf_counter, not the injected clock: this is a pure
-        # duration probe around REAL disk I/O (the raw-clock rule's
-        # explicit exemption) — virtual time would make fsync span
-        # metrics meaningless under simulation
-        t0 = time.perf_counter()
+        # injected clock (the satellite narrowing of the old
+        # perf_counter exemption): under RealClock this is the same
+        # monotonic interval; under SimClock the fsync span measures
+        # virtual time like every other timed quantity in the
+        # subsystem, so sim timelines stay coherent
+        t0 = get_clock().now()
         try:
             self._fh.flush()
             os.fsync(self._fh.fileno())
@@ -509,7 +510,7 @@ class WriteAheadLog:
             # nrlint: disable=lock-discipline — caller (append/sync) holds the lock
             self._failed = e
             raise WalError(f"WAL fsync failed: {e}") from e
-        dur = time.perf_counter() - t0
+        dur = get_clock().now() - t0
         # nrlint: disable=lock-discipline — caller (append/sync) holds the lock
         self._durable = self._tail
         self._m_synced.inc()

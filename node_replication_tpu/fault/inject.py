@@ -13,10 +13,14 @@ Design (the `obs/metrics.py` discipline applied to faults):
 - **Sites** are host-side choke points named by string — `replay`
   (`NodeReplicated._exec_round` / `MultiLogReplicated._exec_round`),
   `append` (`_append_and_replay` / `_append_and_replay_log`),
-  `read-sync` (`execute`), `serve-batch` (`ServeFrontend._run_batch`,
-  BEFORE the batch touches the wrapper, so an injected kill is
-  guaranteed pre-append and therefore safely retryable). Each site is
-  one `fault_hook(site, rid, owner)` call.
+  `read-sync` (`execute`), `serve-batch` (`ServeFrontend._run_batch`
+  and the pipelined assembly stage's `_assemble`, BEFORE the batch
+  touches the wrapper, so an injected kill is guaranteed pre-append
+  and therefore safely retryable in BOTH worker shapes), and
+  `serve-complete` (the pipelined completion stage, AFTER
+  `begin_mut_batch` appended the round — a kill there is post-append
+  by construction, the `maybe_executed=True` class). Each site is one
+  `fault_hook(site, rid, owner)` call.
 - **Disarmed is free**: `fault_hook` loads one module global and
   branches; no allocation, no lock, no clock — the same one-branch
   contract the metrics registry keeps, so the hooks stay compiled into
@@ -58,6 +62,7 @@ from node_replication_tpu.utils.trace import get_tracer
 # loop, `repl/follower.py` apply loop — a raise there exercises the
 # worker-failure reporting the follower-fleet gates depend on).
 SITES = ("replay", "append", "read-sync", "serve-batch",
+         "serve-complete",
          "wal-append", "wal-fsync", "wal-open",
          "ship", "repl-apply")
 ACTIONS = ("raise", "stall", "corrupt", "corrupt-bytes")

@@ -41,7 +41,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 
 from node_replication_tpu.fault.health import (
     HEALTHY,
@@ -50,6 +49,7 @@ from node_replication_tpu.fault.health import (
     HealthTracker,
 )
 from node_replication_tpu.obs.metrics import get_registry
+from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer
 
 logger = logging.getLogger("node_replication_tpu")
@@ -64,14 +64,14 @@ def repair_replica(nr, rid: int, donor: int | None = None) -> dict:
     counted in `fault.repair` / observed in `fault.repair_s` and
     emitted as a `fault-repair` trace event.
     """
-    t0 = time.perf_counter()
+    t0 = get_clock().now()
     donor, donor_ltail = nr.clone_replica_from(rid, donor=donor)
     nr.unfence_replica(rid)
     nr.sync(rid)
     import numpy as np
 
     tail = int(np.asarray(nr.log.tail)) if hasattr(nr.log, "tail") else 0
-    dur = time.perf_counter() - t0
+    dur = get_clock().now() - t0
     reg = get_registry()
     reg.counter("fault.repair").inc()
     reg.histogram("fault.repair_s").observe(dur)

@@ -85,7 +85,8 @@ _MESH_FIELDS = [
 # split by priority class; `lost`/`duplicated` are the ack-chain
 # verifier's hard gates (both must be 0).
 _OVERLOAD_FIELDS = [
-    "name", "mode", "clients", "capacity_ops", "rate", "deadline_ms",
+    "name", "mode", "pipeline_overlap", "clients", "capacity_ops",
+    "rate", "deadline_ms",
     "duration", "arrivals", "accepted", "completed", "good",
     "goodput_ops", "shed", "shed_critical", "shed_normal",
     "shed_bulk", "evicted", "circuit_open", "deadline_miss",
@@ -147,8 +148,8 @@ _CHAOS_FIELDS = [
 # open-loop target (blank for closed loop); shed/deadline_miss are the
 # typed-rejection counts the frontend recorded over the run.
 _SERVE_FIELDS = [
-    "name", "mode", "clients", "rate", "duration", "attempts",
-    "accepted", "completed", "shed", "deadline_miss",
+    "name", "mode", "pipeline_overlap", "clients", "rate", "duration",
+    "attempts", "accepted", "completed", "shed", "deadline_miss",
     "throughput_ops", "p50_ms", "p95_ms", "p99_ms",
 ]
 # Reference column shape (`benches/mkbench.rs:498-552`) with one addition:
@@ -694,6 +695,9 @@ class ServeResult:
     # misses, closed frontend) — transport outcomes, NOT oracle
     # violations; kept apart so `errors` can gate linearizability
     transport_errors: list
+    # serve-pipeline overlap depth the frontend ran at
+    # (`ServeConfig.pipeline_depth`; 0 = serial worker)
+    pipeline_overlap: int = 0
 
     def percentile_ms(self, p: float) -> float:
         if not self.latencies_s:
@@ -874,6 +878,9 @@ def measure_serve(
         deadline_missed=delta["deadline_missed"],
         errors=errors,
         transport_errors=transport,
+        pipeline_overlap=int(getattr(
+            getattr(frontend, "cfg", None), "pipeline_depth", 0,
+        ) or 0),
     )
 
 
@@ -882,6 +889,7 @@ def serve_rows(name: str, res: ServeResult) -> list[dict]:
     return [{
         "name": f"{name}/{res.name}",
         "mode": res.mode,
+        "pipeline_overlap": res.pipeline_overlap,
         "clients": res.clients,
         "rate": "" if res.rate is None else res.rate,
         "duration": round(res.duration_s, 3),
@@ -1465,6 +1473,7 @@ def overload_rows(name: str, run: dict) -> list[dict]:
     return [{
         "name": f"{name}/{run['mode']}",
         "mode": run["mode"],
+        "pipeline_overlap": run.get("pipeline_overlap", 0),
         "clients": run["clients"],
         "capacity_ops": round(run["capacity_ops"], 1),
         "rate": round(run["rate"], 1),

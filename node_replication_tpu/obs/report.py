@@ -409,6 +409,8 @@ def analyze(events: list[dict]) -> dict:
     # sheds/evictions, brownout, per-cause client retries, breaker)
     serve = None
     batches = [e for e in events if e.get("event") == "serve-batch"]
+    assembles = [e for e in events
+                 if e.get("event") == "serve-assemble"]
     sheds = [e for e in events if e.get("event") == "serve-shed"]
     misses = [e for e in events
               if e.get("event") == "serve-deadline-miss"]
@@ -421,8 +423,8 @@ def analyze(events: list[dict]) -> dict:
     brownout_reads = [e for e in events
                       if e.get("event") == "serve-brownout-read"]
     circuits = [e for e in events if e.get("event") == "serve-circuit"]
-    if (batches or sheds or misses or evicts or retries or limits
-            or brownouts or circuits):
+    if (batches or assembles or sheds or misses or evicts or retries
+            or limits or brownouts or circuits):
         sizes = sorted(int(e.get("n", 0)) for e in batches)
         size_hist: dict[int, int] = defaultdict(int)
         for n in sizes:
@@ -446,9 +448,42 @@ def analyze(events: list[dict]) -> dict:
             sec = int(_event_time(e, mono0, ts0))
             lim = int(e.get("limit", 0))
             limit_tl[sec] = min(limit_tl.get(sec, 1 << 30), lim)
+        # pipelined-serving overlap picture (ISSUE 14): the
+        # serve-batch span is the round's device+completion half, the
+        # serve-assemble event the host assembly half — their busy
+        # fractions over the serve window show how much of the host
+        # work the pipeline actually hid (serial traces have no
+        # serve-assemble events and skip the line)
+        pipe = None
+        if assembles:
+            times = [_event_time(e, mono0, ts0)
+                     for e in batches + assembles]
+            window = max(times) - min(times) if len(times) > 1 else 0.0
+            device_s = sum(
+                float(e.get("duration_s", 0.0)) for e in batches
+            )
+            asm_s = sum(
+                float(e.get("duration_s", 0.0)) for e in assembles
+            )
+            pipe = {
+                "assemble_events": len(assembles),
+                "assembly_busy_s": asm_s,
+                "device_busy_s": device_s,
+                "window_s": window,
+                "assembly_busy_frac": (
+                    asm_s / window if window > 0 else 0.0
+                ),
+                "device_busy_frac": (
+                    device_s / window if window > 0 else 0.0
+                ),
+            }
         serve = {
             "batches": len(batches),
             "ops": sum(sizes),
+            "late_success": sum(
+                int(e.get("late_success", 0) or 0) for e in batches
+            ),
+            "pipeline": pipe,
             "p50_batch": _percentile([float(s) for s in sizes], 0.50),
             "max_batch": sizes[-1] if sizes else 0,
             "batch_size_hist": dict(sorted(size_hist.items())),
@@ -807,7 +842,16 @@ def render(report: dict, out=None) -> None:
           f"evicted: {serve.get('evicted', 0)}   "
           f"deadline-missed: {serve['deadline_miss']}"
           + (f" ({serve['swept_at_admission']} swept at admission)"
-             if serve.get("swept_at_admission") else "") + "\n")
+             if serve.get("swept_at_admission") else "")
+          + (f"   late successes: {serve['late_success']}"
+             if serve.get("late_success") else "") + "\n")
+        pipe = serve.get("pipeline")
+        if pipe:
+            w(f"  pipeline overlap: assembly busy "
+              f"{100.0 * pipe['assembly_busy_frac']:.0f}% / device "
+              f"busy {100.0 * pipe['device_busy_frac']:.0f}% over "
+              f"{pipe['window_s']:.1f}s "
+              f"({pipe['assemble_events']} assembled round(s))\n")
         retries = serve.get("retries_by_cause") or {}
         if retries:
             w("  client retries by cause: "

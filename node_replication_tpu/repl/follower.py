@@ -49,7 +49,6 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-import time
 
 import numpy as np
 
@@ -460,7 +459,7 @@ class Follower:
         not self-fenced); fsync the follower's WAL (the drained
         records become durable history HERE before any new ack is
         issued); re-home write serving (`enable_writes`)."""
-        t0 = time.perf_counter()
+        t0 = get_clock().now()
         self.stop_apply()
         if self._thread.ident and self._thread.is_alive():
             # a wedged apply thread and the drain below would both
@@ -514,7 +513,7 @@ class Follower:
             self._promoted = True
         self.nr.wal_sync()
         self.frontend.enable_writes()
-        dur = time.perf_counter() - t0
+        dur = get_clock().now() - t0
         applied = self.applied_pos()
         get_registry().counter("repl.promotions").inc()
         get_tracer().emit(

@@ -35,7 +35,6 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-import time
 
 from node_replication_tpu.fault.health import (
     HEALTHY,
@@ -160,9 +159,9 @@ class PromotionManager:
         """Elect and promote immediately (detection already done, or
         operator-initiated failover)."""
         chosen = self.elect()
-        t0 = time.perf_counter()
+        t0 = get_clock().now()
         rep = chosen.promote()
-        promote_s = time.perf_counter() - t0
+        promote_s = get_clock().now() - t0
         report = PromotionReport(
             follower=rep["name"],
             new_epoch=rep["epoch"],

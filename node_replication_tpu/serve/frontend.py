@@ -78,7 +78,6 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-import time
 from collections import deque
 from typing import Callable, Sequence
 
@@ -145,6 +144,18 @@ class ServeConfig:
     - `wal_lag_low` / `wal_lag_high` — watermarks (log positions) for
       the auto-registered WAL fsync-lag backpressure source (only
       read when `overload` is set and a WAL is attached).
+    - `pipeline_depth` — serve-pipeline overlap depth (default 0 =
+      today's fully serial worker, the safety switch). At depth 1 the
+      per-replica worker splits into an ASSEMBLY stage (drain queue,
+      sweep deadlines, build the batch, `begin_mut_batch`) and a
+      COMPLETION stage (`finish_mut_batch`, durable-ack barrier,
+      resolve futures), with at most ONE round in flight per replica:
+      round N+1's host work overlaps round N's device work. Capped at
+      1 — a second in-flight round would interleave response delivery
+      across rounds (breaking future ordering), make post-append
+      failure attribution (`maybe_executed`) ambiguous, and split the
+      WAL group-commit unit; depth 1 already hides the host work, so
+      deeper pipelines buy latency risk for nothing.
     - `durability` — the durable-ack contract against the wrapper's
       attached write-ahead log (`durable/wal.py`). `"none"` (default):
       acks are in-memory only (the pre-durability semantics, WAL or
@@ -165,6 +176,7 @@ class ServeConfig:
     default_deadline_s: float | None = None
     drain_timeout_s: float = 30.0
     failover: bool = False
+    pipeline_depth: int = 0
     durability: str = "none"
     overload: OverloadConfig | None = None
     wal_lag_low: int = 1024
@@ -186,6 +198,13 @@ class ServeConfig:
             raise ValueError("batch_max_ops must be >= 1")
         if self.batch_linger_s < 0:
             raise ValueError("batch_linger_s must be >= 0")
+        if self.pipeline_depth not in (0, 1):
+            raise ValueError(
+                f"pipeline_depth must be 0 (serial) or 1 (one round "
+                f"in flight); got {self.pipeline_depth} — deeper "
+                f"pipelines would interleave response delivery across "
+                f"rounds and break maybe_executed attribution"
+            )
         if self.durability not in ("none", "batch", "always"):
             raise ValueError(
                 f"unknown durability {self.durability!r} "
@@ -253,6 +272,131 @@ class _OfferResult:
         self.expired = expired
         self.evicted = evicted
         self.inversion = inversion
+
+
+class _Staged:
+    """One assembled-and-begun round in the assembly→completion
+    handoff (`ServeConfig.pipeline_depth > 0`): the wrapper's pending
+    round handle plus everything the completion stage needs to
+    deliver it (live requests, sweep accounting, the assembly-time
+    queue-delay already fed to the governor)."""
+
+    __slots__ = ("pending", "live", "missed", "taken", "t0", "delay")
+
+    def __init__(self, pending, live, missed, taken, t0, delay):
+        self.pending = pending
+        self.live = live
+        self.missed = missed
+        self.taken = taken
+        self.t0 = t0
+        self.delay = delay
+
+
+class _PipelineChannel:
+    """Capacity-1 handoff between one replica's assembly and
+    completion stages, plus the one-round-in-flight barrier.
+
+    A round is *busy* from `put` (assembly has begun it) until the
+    completion stage's `device_done` — which fires right after
+    `finish_mut_batch` returns, BEFORE the durable-ack barrier and
+    future resolution. That early signal is where the pipeline's
+    overlap lives: the assembly stage's `wait_clear` wakes while
+    round N's completion host work (fsync, ship barrier, callbacks,
+    accounting) is still running, drains the queue that filled during
+    round N, and begins round N+1 — whose device work (append, or the
+    whole fused kernel) then runs under round N's remaining host work
+    and round N+1's own assembly. The wrapper-level invariant holds
+    throughout: `begin(N+1)` happens only after `finish(N)` returned,
+    so at most one split round is ever open per replica.
+
+    On a completion-stage death (`round_done(exc)`), the channel is
+    poisoned: `wait_clear` returns the killer, and a `put` racing the
+    death is refused (returning the killer) so the assembly stage can
+    tear its already-begun round down honestly instead of stranding
+    it in a slot nobody will drain. All waits route through the
+    injectable clock (`utils/clock.py`) so simulated runs stay
+    deterministic."""
+
+    __slots__ = ("_lock", "_slot", "_busy", "_closed", "_dead")
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._slot: _Staged | None = None
+        self._busy = False
+        self._closed = False
+        self._dead: BaseException | None = None
+
+    def wait_clear(self) -> BaseException | None:
+        """Block until the in-flight round's device half is done (or
+        the channel is poisoned); returns the completion stage's
+        killing exception (None when clear and alive)."""
+        clock = get_clock()
+        with self._lock:
+            while self._busy and self._dead is None:
+                clock.wait(self._lock)
+            return self._dead
+
+    def put(self, staged: _Staged) -> BaseException | None:
+        """Hand one begun round to the completion stage. Returns None
+        on success, or the channel-poisoning exception when the
+        completion stage died between the caller's `wait_clear` and
+        now — the round is already begun (post-append), so the caller
+        must tear it down, not retry it."""
+        with self._lock:
+            if self._dead is not None:
+                return self._dead
+            self._slot = staged
+            self._busy = True
+            self._lock.notify_all()
+            return None
+
+    def take(self) -> _Staged | None:
+        """Completion stage: next round, or None once closed and
+        drained (the stage's exit signal)."""
+        clock = get_clock()
+        with self._lock:
+            while self._slot is None and not self._closed:
+                clock.wait(self._lock)
+            staged = self._slot
+            self._slot = None
+            return staged
+
+    def device_done(self) -> None:
+        """Completion stage: `finish_mut_batch` returned — the round's
+        device work is complete and the wrapper slot is free, so the
+        assembly stage may begin the next round while delivery
+        continues."""
+        with self._lock:
+            self._busy = False
+            self._lock.notify_all()
+
+    def round_done(self, exc: BaseException | None = None) -> None:
+        """Completion stage: the round died (with `exc`: poison the
+        channel so the assembly stage stops) or ended without reaching
+        `device_done`. Called AFTER `_fail_replica` on the failure
+        path, so a woken assembly stage observes the failover already
+        in motion."""
+        with self._lock:
+            self._busy = False
+            if exc is not None:
+                self._dead = exc
+            self._lock.notify_all()
+
+    def drain_slot(self) -> _Staged | None:
+        """Pop a staged round nobody will serve (completion-death
+        teardown): the assembly stage may have begun and handed off
+        round N+1 while round N was mid-delivery."""
+        with self._lock:
+            staged = self._slot
+            self._slot = None
+            return staged
+
+    def close(self) -> None:
+        """Assembly stage exit: no more rounds will be put; the
+        completion stage drains the in-flight one and exits."""
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
 
 
 class _SubmissionQueue:
@@ -408,12 +552,20 @@ class _SubmissionQueue:
             for d in self._items:
                 while d and len(batch) < max_ops:
                     batch.append(d.popleft())
-            self._in_service = len(batch)
+            # additive: a pipelined frontend can have one round in
+            # flight AND the next batch taken for assembly, and
+            # wait_idle must see both (serial mode only ever holds one)
+            self._in_service += len(batch)
             return batch
 
-    def batch_done(self, completed: int, missed: int) -> None:
+    def batch_done(self, completed: int, missed: int,
+                   taken: int) -> None:
+        """Retire one taken batch (`taken` = its size at `take_batch`,
+        whatever later happened to its requests). Clamped: the worker
+        loop's last-resort guard cannot know whether the failed round
+        already retired itself."""
         with self._lock:
-            self._in_service = 0
+            self._in_service = max(0, self._in_service - taken)
             self.completed += completed
             self.deadline_missed += missed
             self._lock.notify_all()  # wake wait_idle
@@ -505,6 +657,13 @@ class ServeFrontend:
             )
         self._nr = nr
         self.cfg = config or ServeConfig()
+        if self.cfg.pipeline_depth > 0 and not hasattr(
+                nr, "begin_mut_batch"):
+            raise TypeError(
+                f"{type(nr).__name__} has no begin_mut_batch/"
+                f"finish_mut_batch; pipelined serving needs the "
+                f"split-round protocol (core/replica.py)"
+            )
         # durable-ack wiring (`durable/`): both durable modes need the
         # WAL present NOW — discovering its absence at the first batch
         # would resolve futures that were promised durability
@@ -532,6 +691,7 @@ class ServeFrontend:
             self.governor = OverloadGovernor(
                 self.cfg.overload, self.cfg.queue_depth,
                 deadline_s=self.cfg.default_deadline_s,
+                pipeline_depth=self.cfg.pipeline_depth,
             )
             if hasattr(nr, "wal"):
                 # end-to-end backpressure, leg 1: the journal's
@@ -559,6 +719,11 @@ class ServeFrontend:
         self._started = False
         self._queues: dict[int, _SubmissionQueue] = {}
         self._workers: dict[int, threading.Thread] = {}
+        # pipelined serving (`pipeline_depth > 0`): per-replica
+        # completion-stage threads + handoff channels; empty in serial
+        # mode so nothing below pays for the feature being off
+        self._completers: dict[int, threading.Thread] = {}
+        self._channels: dict[int, _PipelineChannel] = {}
         self._read_tokens: dict[int, object] = {}
         self._depth_gauges: dict[int, object] = {}
         # failover state: failed rid -> the exception that killed its
@@ -601,6 +766,11 @@ class ServeFrontend:
                                            buckets=COUNT_BUCKETS)
         self._m_batch_dur = reg.histogram("serve.batch.duration_s")
         self._m_req_lat = reg.histogram("serve.request.latency_s")
+        # requests that expired while their round was in flight and
+        # still resolved successfully (the completion-stage second
+        # sweep): delivered — first resolution wins, the op executed —
+        # but counted so SLO accounting stays honest
+        self._m_late = reg.counter("serve.deadline_late_success")
 
         #: mesh fleet (`NodeReplicated(mesh=...)`): worker-per-replica
         #: → device map. Each combiner worker owns a replica whose
@@ -617,9 +787,7 @@ class ServeFrontend:
                 rid = int(rid)
                 if rid in self._queues:
                     raise ValueError(f"replica {rid} served twice")
-                (self._queues[rid], self._workers[rid],
-                 self._read_tokens[rid],
-                 self._depth_gauges[rid]) = self._new_replica(rid)
+                self._store_replica(rid, self._new_replica(rid))
                 self._record_device(rid)
 
         #: fleet observability side port (`ServeConfig.obs_port`,
@@ -690,21 +858,56 @@ class ServeFrontend:
         only through the frontend)."""
         return self._nr
 
-    def _new_replica(self, rid: int):
-        """Build the queue/worker/token/gauge quad for one replica;
-        the CALLER stores them into the topology dicts under `_lock`
-        (so every write to the guarded dicts is visibly locked). The
-        worker starts only via `start()`."""
-        q = _SubmissionQueue(self.cfg.queue_depth)
+    def _spawn_workers(self, rid: int, q: "_SubmissionQueue"):
+        """Worker thread(s) for one replica: the serial combiner loop,
+        or (`pipeline_depth > 0`) the assembly + completion stage pair
+        joined by a capacity-1 handoff channel. Returns
+        `(worker, completer, channel)` — the latter two None in serial
+        mode. The CALLER stores them into the topology dicts under
+        `_lock`; threads start only via `start()`."""
+        if self.cfg.pipeline_depth > 0:
+            chan = _PipelineChannel()
+            asm = threading.Thread(
+                target=self._assembly_loop, args=(rid, q, chan),
+                name=f"serve-asm-r{rid}", daemon=True,
+            )
+            cpl = threading.Thread(
+                target=self._completion_loop, args=(rid, q, chan),
+                name=f"serve-cpl-r{rid}", daemon=True,
+            )
+            return asm, cpl, chan
         t = threading.Thread(
             target=self._worker_loop, args=(rid, q),
             name=f"serve-worker-r{rid}", daemon=True,
         )
+        return t, None, None
+
+    def _new_replica(self, rid: int):
+        """Build the queue/worker(s)/token/gauge set for one replica;
+        the CALLER stores them into the topology dicts under `_lock`
+        (so every write to the guarded dicts is visibly locked). The
+        workers start only via `start()`."""
+        q = _SubmissionQueue(self.cfg.queue_depth)
+        t, cpl, chan = self._spawn_workers(rid, q)
         token = self._nr.register(rid)
         gauge = get_registry().gauge(f"serve.queue_depth.r{rid}")
         if self.governor is not None:
             self.governor.register_replica(rid)
-        return q, t, token, gauge
+        return q, t, cpl, chan, token, gauge
+
+    def _store_replica(self, rid: int, built) -> None:
+        """Store one `_new_replica` result into the topology dicts;
+        caller holds `_lock`."""
+        q, t, cpl, chan, token, gauge = built
+        # both callers (the constructor and grow()) hold _lock, which
+        # is non-reentrant — re-acquiring here would deadlock
+        self._queues[rid] = q  # nrlint: disable=lock-discipline
+        self._workers[rid] = t  # nrlint: disable=lock-discipline
+        if cpl is not None:
+            self._completers[rid] = cpl  # nrlint: disable=lock-discipline
+            self._channels[rid] = chan  # nrlint: disable=lock-discipline
+        self._read_tokens[rid] = token  # nrlint: disable=lock-discipline
+        self._depth_gauges[rid] = gauge  # nrlint: disable=lock-discipline
 
     def start(self) -> None:
         """Start every not-yet-running worker (idempotent)."""
@@ -712,7 +915,8 @@ class ServeFrontend:
             if self._closed:
                 raise FrontendClosed("cannot start a closed frontend")
             self._started = True
-            for t in self._workers.values():
+            for t in (list(self._workers.values())
+                      + list(self._completers.values())):
                 if not t.is_alive() and not t.ident:
                     t.start()
 
@@ -737,9 +941,7 @@ class ServeFrontend:
                 rid = int(rid)
                 if rid in self._queues:
                     raise ValueError(f"replica {rid} served twice")
-                (self._queues[rid], self._workers[rid],
-                 self._read_tokens[rid],
-                 self._depth_gauges[rid]) = self._new_replica(rid)
+                self._store_replica(rid, self._new_replica(rid))
                 self._record_device(rid)
             started = self._started
         get_tracer().emit("serve-grow", rids=list(map(int, new_rids)))
@@ -857,12 +1059,12 @@ class ServeFrontend:
                     self._retired_prio.get(name, 0) + v
                 )
             q = _SubmissionQueue(self.cfg.queue_depth)
-            t = threading.Thread(
-                target=self._worker_loop, args=(rid, q),
-                name=f"serve-worker-r{rid}", daemon=True,
-            )
+            t, cpl, chan = self._spawn_workers(rid, q)
             self._queues[rid] = q
             self._workers[rid] = t
+            if cpl is not None:
+                self._completers[rid] = cpl
+                self._channels[rid] = chan
             # fresh gauge registration: `_fail_replica` removed the
             # retired replica's name from the registry
             self._depth_gauges[rid] = get_registry().gauge(
@@ -873,6 +1075,8 @@ class ServeFrontend:
         get_tracer().emit("serve-replica-restart", rid=rid)
         if started:
             t.start()
+            if cpl is not None:
+                cpl.start()
 
     def drain(self, timeout: float | None = None) -> bool:
         """Block until every queue is empty and no batch is in flight.
@@ -901,7 +1105,8 @@ class ServeFrontend:
                 return
             self._closed = True
             queues = list(self._queues.items())
-            workers = list(self._workers.values())
+            workers = (list(self._workers.values())
+                       + list(self._completers.values()))
             gauges = dict(self._depth_gauges)
             started = self._started
         leftovers: list[_Request] = []
@@ -1224,9 +1429,44 @@ class ServeFrontend:
                 # it DID resolve are untouched)
                 for req in batch:
                     req.future._reject(e)
-                q.batch_done(0, 0)
+                q.batch_done(0, 0, len(batch))
                 if cfg.failover:
                     return
+
+    def _sweep_batch(self, rid: int, q: _SubmissionQueue,
+                     batch: list[_Request]):
+        """Batch-assembly head shared by the serial round and the
+        pipelined assembly stage: the AIMD update (the queue-delay
+        control signal is measured HERE, at assembly — a pipelined
+        round's in-flight time must not double-count into the
+        governor's sojourn signal) and the pre-append deadline sweep.
+        Returns `(live, missed, delay)`."""
+        now = get_clock().now()
+        delay = 0.0
+        if batch:
+            delay = max(
+                0.0, now - min(r.future.t_submit for r in batch)
+            )
+        if self.governor is not None and batch:
+            # the control signal: how long the batch's OLDEST request
+            # waited between admission and assembly (CoDel's sojourn
+            # time) — one AIMD update per combiner round
+            self.governor.on_round(rid, delay, len(batch))
+        live: list[_Request] = []
+        missed = 0
+        for req in batch:
+            dl = req.future.deadline
+            if dl is not None and now > dl:
+                missed += 1
+                req.future._reject(
+                    DeadlineExceeded(rid, now - dl)
+                )
+            else:
+                live.append(req)
+        if missed:
+            self._m_miss.inc(missed)
+            get_tracer().emit("serve-deadline-miss", rid=rid, n=missed)
+        return live, missed, delay
 
     def _run_batch(self, rid: int, q: _SubmissionQueue,
                    batch: list[_Request]) -> None:
@@ -1243,35 +1483,13 @@ class ServeFrontend:
         except Exception as e:
             if not self.cfg.failover:
                 raise
-            q.batch_done(0, 0)
+            q.batch_done(0, 0, len(batch))
             raise _ReplicaDown(e, batch, maybe_executed=False) from e
-        now = get_clock().now()
-        if self.governor is not None and batch:
-            # the control signal: how long the batch's OLDEST request
-            # waited between admission and assembly (CoDel's sojourn
-            # time) — one AIMD update per combiner round
-            delay = max(
-                0.0, now - min(r.future.t_submit for r in batch)
-            )
-            self.governor.on_round(rid, delay, len(batch))
-        live: list[_Request] = []
-        missed = 0
-        for req in batch:
-            dl = req.future.deadline
-            if dl is not None and now > dl:
-                missed += 1
-                req.future._reject(
-                    DeadlineExceeded(rid, now - dl)
-                )
-            else:
-                live.append(req)
-        if missed:
-            self._m_miss.inc(missed)
-            get_tracer().emit("serve-deadline-miss", rid=rid, n=missed)
+        live, missed, delay = self._sweep_batch(rid, q, batch)
         if not live:
-            q.batch_done(0, missed)
+            q.batch_done(0, missed, len(batch))
             return
-        t0 = time.perf_counter()
+        t0 = get_clock().now()
         try:
             resps = self._nr.execute_mut_batch(
                 [req.op for req in live], rid
@@ -1287,7 +1505,7 @@ class ServeFrontend:
                 pre_append = isinstance(e, ReplicaFencedError) or (
                     isinstance(e, FaultError) and e.site == "append"
                 )
-                q.batch_done(0, missed)
+                q.batch_done(0, missed, len(batch))
                 logger.exception(
                     "serve worker r%d: batch of %d failed; retiring "
                     "replica", rid, len(live)
@@ -1297,11 +1515,25 @@ class ServeFrontend:
                 ) from e
             for req in live:
                 req.future._reject(e)
-            q.batch_done(0, missed)
+            q.batch_done(0, missed, len(batch))
             logger.exception(
                 "serve worker r%d: batch of %d failed", rid, len(live)
             )
             return
+        self._finish_delivery(rid, q, live, missed, len(batch),
+                              resps, t0, delay)
+
+    def _finish_delivery(self, rid: int, q: _SubmissionQueue,
+                         live: list[_Request], missed: int,
+                         taken: int, resps: list, t0: float,
+                         delay: float) -> None:
+        """Delivery tail shared by the serial round and the pipelined
+        completion stage: durable-ack barrier, the SECOND deadline
+        sweep (late successes delivered but counted —
+        `serve.deadline_late_success`), future resolution, accounting,
+        and the `serve-batch` trace event. Raises `_ReplicaDown` on a
+        barrier failure in failover mode, exactly like the execute
+        path (post-append: `maybe_executed=True`)."""
         barrier = self.ack_barrier
         if self._durable_sync or barrier is not None:
             # durable-ack barrier (`ServeConfig(durability="batch")`):
@@ -1342,7 +1574,7 @@ class ServeFrontend:
                         )
                     barrier(durable)
             except Exception as e:
-                q.batch_done(0, missed)
+                q.batch_done(0, missed, taken)
                 logger.exception(
                     "serve worker r%d: durable-ack barrier failed for "
                     "batch of %d", rid, len(live)
@@ -1354,13 +1586,26 @@ class ServeFrontend:
                 for req in live:
                     req.future._reject(e)
                 return
-        dur = time.perf_counter() - t0
+        now2 = get_clock().now()
+        dur = now2 - t0
+        # second deadline sweep, at delivery: a request that expired
+        # while its round was in flight DID execute — deliver the
+        # response (first resolution wins; nothing changes for the
+        # future) but count it, so SLO accounting never claims an
+        # in-deadline success that wasn't
+        late = sum(
+            1 for req in live
+            if req.future.deadline is not None
+            and now2 > req.future.deadline
+        )
+        if late:
+            self._m_late.inc(late)
         for req, resp in zip(live, resps):
             req.future._resolve(resp)
             lat = req.future.latency_s
             if lat is not None:
                 self._m_req_lat.observe(lat)
-        q.batch_done(len(live), missed)
+        q.batch_done(len(live), missed, taken)
         depth = q.depth()
         self._m_batches.inc()
         self._m_completed.inc(len(live))
@@ -1386,11 +1631,260 @@ class ServeFrontend:
             tracer.emit(
                 "serve-batch", rid=rid, n=len(live), expired=missed,
                 queue_depth=depth, duration_s=dur,
-                queue_delay_s=max(
-                    0.0, now - min(r.future.t_submit for r in live)
-                ),
+                queue_delay_s=delay,
+                late_success=late,
                 pos=(pos_of(rid) if pos_of is not None else None),
                 engine=(tier_of(rid) if tier_of is not None
                         else getattr(self._nr, "last_round_tier",
                                      None)),
             )
+
+    # ----------------------------------------------------- pipelined worker
+
+    def _assembly_loop(self, rid: int, q: _SubmissionQueue,
+                       chan: _PipelineChannel) -> None:
+        """Assembly stage (`pipeline_depth > 0`, thread
+        `serve-asm-r{rid}`): wait for the in-flight round's device
+        half (`wait_clear` — the queue keeps FILLING through the whole
+        round, so batches stay as large as the serial worker's), then
+        drain the queue, sweep deadlines, begin the round
+        (`begin_mut_batch` — the batch is appended and, on the fused
+        tier, the kernel launched when it returns), and hand off. The
+        drain + sweep + begin of round N+1 overlap round N's
+        completion-stage host work (barrier, future resolution), and
+        round N+1's device work overlaps both.
+
+        Death discipline mirrors `_worker_loop`: a begin failure in
+        failover mode retires the replica FIRST, then rejects. When
+        the completion stage died instead, a not-yet-begun batch never
+        exists here (the queue was already closed and re-homed by
+        `_fail_replica`) — but a begun round whose `put` the poisoned
+        channel refused is post-append, and is torn down honestly
+        (`_abort_staged`)."""
+        cfg = self.cfg
+        while True:
+            dead = chan.wait_clear()
+            if dead is not None:
+                # completion died and already retired the replica
+                # (`_fail_replica` ran before `round_done(exc)`);
+                # queued requests were re-homed there, nothing is
+                # taken or begun on this side — just exit
+                return
+            batch = q.take_batch(cfg.batch_max_ops,
+                                 cfg.batch_linger_s)
+            if batch is None:
+                chan.close()  # completion drains in-flight, exits
+                return
+            try:
+                staged = self._assemble(rid, q, batch)
+            except _ReplicaDown as down:
+                chan.close()
+                self._fail_replica(rid, q, down.cause)
+                for req in down.pending:
+                    req.future._reject(ReplicaFailed(
+                        rid, down.cause,
+                        maybe_executed=down.maybe_executed,
+                    ))
+                return
+            except Exception as e:  # pragma: no cover - last resort
+                logger.exception(
+                    "serve assembly r%d: unexpected failure", rid
+                )
+                q.batch_done(0, 0, len(batch))
+                for req in batch:
+                    req.future._reject(e)
+                if cfg.failover:
+                    chan.close()
+                    self._fail_replica(rid, q, e)
+                    return
+                continue
+            if staged is None:
+                continue  # whole batch expired at the sweep
+            dead = chan.put(staged)
+            if dead is not None:
+                # completion died between wait_clear and put: the
+                # round IS begun (appended) — post-append teardown
+                self._abort_staged(rid, q, staged, dead)
+                return
+
+    def _abort_staged(self, rid: int, q: _SubmissionQueue,
+                      staged: _Staged, cause: BaseException) -> None:
+        """Tear down a begun round nobody will finish (completion-
+        stage death): release the wrapper's in-flight slot and drop
+        its deliveries (`abort_mut_batch` — the ops are appended and
+        WILL replay; only responses are lost), then reject with
+        post-append `maybe_executed=True` honesty. `_fail_replica`
+        already ran on the completion thread."""
+        q.batch_done(0, staged.missed, staged.taken)
+        abort = getattr(self._nr, "abort_mut_batch", None)
+        if abort is not None:
+            try:
+                abort(staged.pending)
+            # the guard only shields the teardown's slot release; the
+            # failure IS recorded — every future of the staged round
+            # rejects typed immediately below, and the replica is
+            # already marked failed (`_fail_replica` ran first)
+            # nrlint: disable=swallowed-worker-exception
+            except Exception:  # pragma: no cover - teardown guard
+                logger.exception(
+                    "serve r%d: abort_mut_batch failed during "
+                    "failover teardown", rid
+                )
+        for req in staged.live:
+            req.future._reject(ReplicaFailed(
+                rid, cause, maybe_executed=True,
+            ))
+
+    def _assemble(self, rid: int, q: _SubmissionQueue,
+                  batch: list[_Request]) -> "_Staged | None":
+        """One assembly pass: injection choke point, AIMD update +
+        deadline sweep (`_sweep_batch` — the queue-delay signal is
+        measured here, never at completion), `begin_mut_batch`.
+        Returns the staged round for the completion stage (None when
+        every request expired). Raises `_ReplicaDown` in failover
+        mode; the begin failure is pre-append retryable exactly when
+        it is the fence guard or an append/serve-batch-site injection
+        — the same classification as the serial path."""
+        try:
+            # pre-append injection site, same as the serial worker: a
+            # kill here fires before any op can touch the log
+            fault_hook("serve-batch", rid, self._nr)
+        except Exception as e:
+            if not self.cfg.failover:
+                raise
+            q.batch_done(0, 0, len(batch))
+            raise _ReplicaDown(e, batch, maybe_executed=False) from e
+        clock = get_clock()
+        t_asm = clock.now()
+        live, missed, delay = self._sweep_batch(rid, q, batch)
+        if not live:
+            q.batch_done(0, missed, len(batch))
+            return None
+        t0 = clock.now()
+        try:
+            pending = self._nr.begin_mut_batch(
+                [req.op for req in live], rid
+            )
+        except Exception as e:
+            pre_append = isinstance(e, ReplicaFencedError) or (
+                isinstance(e, FaultError) and e.site == "append"
+            )
+            q.batch_done(0, missed, len(batch))
+            logger.exception(
+                "serve assembly r%d: begin of %d failed", rid,
+                len(live)
+            )
+            if self.cfg.failover:
+                raise _ReplicaDown(
+                    e, live, maybe_executed=not pre_append
+                ) from e
+            for req in live:
+                req.future._reject(e)
+            return None
+        tracer = get_tracer()
+        if tracer.enabled:
+            # the assembly half of the overlap picture (obs/report's
+            # serve section pairs this with the serve-batch span to
+            # show assembly-vs-device busy fractions)
+            tracer.emit(
+                "serve-assemble", rid=rid, n=len(live),
+                expired=missed, duration_s=clock.now() - t_asm,
+                queue_delay_s=delay,
+            )
+        return _Staged(pending, live, missed, len(batch), t0, delay)
+
+    def _completion_loop(self, rid: int, q: _SubmissionQueue,
+                         chan: _PipelineChannel) -> None:
+        """Completion stage (thread `serve-cpl-r{rid}`): finish the
+        in-flight round (`finish_mut_batch` — the device replay /
+        fused readback), signal `device_done` (the assembly stage may
+        begin the next round NOW), then run the durable-ack barrier,
+        resolve futures, fire `batch_done` and accounting. A round
+        that dies here is post-append by construction
+        (`maybe_executed=True`); the replica is retired BEFORE
+        `round_done(exc)` wakes the assembly stage, so every observer
+        finds the failover in motion — and a round the assembly began
+        during our delivery is drained and torn down with the same
+        post-append honesty."""
+        while True:
+            staged = chan.take()
+            if staged is None:
+                return
+            try:
+                self._complete(rid, q, staged, chan)
+            except _ReplicaDown as down:
+                self._fail_replica(rid, q, down.cause)
+                for req in down.pending:
+                    req.future._reject(ReplicaFailed(
+                        rid, down.cause,
+                        maybe_executed=down.maybe_executed,
+                    ))
+                stale = chan.drain_slot()
+                if stale is not None:
+                    # begun (appended) while round N was mid-delivery;
+                    # nobody will finish it — post-append teardown
+                    self._abort_staged(rid, q, stale, down.cause)
+                chan.round_done(down.cause)
+                return
+            except Exception as e:  # pragma: no cover - last resort
+                logger.exception(
+                    "serve completion r%d: unexpected failure", rid
+                )
+                for req in staged.live:
+                    req.future._reject(e)
+                q.batch_done(0, 0, staged.taken)
+                if self.cfg.failover:
+                    self._fail_replica(rid, q, e)
+                    stale = chan.drain_slot()
+                    if stale is not None:
+                        self._abort_staged(rid, q, stale, e)
+                    chan.round_done(e)
+                    return
+                chan.round_done()
+                continue
+
+    def _complete(self, rid: int, q: _SubmissionQueue,
+                  staged: _Staged, chan: _PipelineChannel) -> None:
+        """One completion pass: post-append injection site, finish the
+        round, release the assembly stage (`device_done`), shared
+        delivery tail (`_finish_delivery`: barrier, second deadline
+        sweep, future resolution, accounting)."""
+        live, missed, taken = staged.live, staged.missed, staged.taken
+        try:
+            # post-append injection site: the round is begun — a kill
+            # here loses responses, never ops (maybe_executed=True)
+            fault_hook("serve-complete", rid, self._nr)
+            resps = self._nr.finish_mut_batch(staged.pending)
+        except Exception as e:
+            q.batch_done(0, missed, taken)
+            logger.exception(
+                "serve completion r%d: finish of %d failed", rid,
+                len(live)
+            )
+            # release the wrapper's in-flight slot: when the failure
+            # struck BEFORE finish_mut_batch (the serve-complete
+            # injection site) the begun round is still registered, and
+            # a restarted worker's first begin would refuse forever.
+            # Idempotent — a no-op when finish's own cleanup already
+            # ran (or fence_replica's crash semantics will).
+            abort = getattr(self._nr, "abort_mut_batch", None)
+            if abort is not None:
+                abort(staged.pending)
+            if self.cfg.failover:
+                raise _ReplicaDown(
+                    e, live, maybe_executed=True
+                ) from e
+            for req in live:
+                req.future._reject(e)
+            # non-failover: the replica keeps serving — release the
+            # assembly stage (the round left flight unsuccessfully;
+            # without this the channel stays busy and every later
+            # submission wedges in wait_clear)
+            chan.round_done()
+            return
+        # the overlap release point: the wrapper slot is free and the
+        # responses are in hand — everything below is host-only work
+        # that round N+1's assembly (and device work) runs under
+        chan.device_done()
+        self._finish_delivery(rid, q, live, missed, taken, resps,
+                              staged.t0, staged.delay)

@@ -161,10 +161,21 @@ class OverloadGovernor:
     `brownout()` on the read path. All methods are thread-safe."""
 
     def __init__(self, cfg: OverloadConfig, queue_depth: int,
-                 deadline_s: float | None = None):
+                 deadline_s: float | None = None,
+                 pipeline_depth: int = 0):
         self.cfg = cfg
         self._depth = int(queue_depth)
         self._deadline_s = deadline_s
+        #: serve-pipeline overlap depth (`ServeConfig.pipeline_depth`).
+        #: The controller needs no special casing for it — the
+        #: queue-delay signal it keys on is measured at batch ASSEMBLY
+        #: (`ServeFrontend._sweep_batch`), so a pipelined round's
+        #: in-flight time never double-counts into the sojourn signal;
+        #: pipelining simply shrinks the measured delay, and the AIMD
+        #: loop converts that into admission headroom. Recorded here
+        #: so `stats()` (and the bench CSVs) can attribute a run's
+        #: limits to its overlap mode.
+        self.pipeline_depth = int(pipeline_depth)
         self._lock = threading.Lock()
         self._limits: dict[int, float] = {}
         self._gauges: dict[int, object] = {}
@@ -332,6 +343,7 @@ class OverloadGovernor:
             out = {
                 "limits": {r: int(v)
                            for r, v in sorted(self._limits.items())},
+                "pipeline_depth": self.pipeline_depth,
                 "ewma_delay_s": self._ewma,
                 "brownout": self._brownout,
                 "brownout_reads": self._brownout_reads,

@@ -822,17 +822,44 @@ class TestRawClockInSubsystem:
 
     def test_clock_routed_and_exempt_calls_clean(self, tmp_path):
         diags = self._lint_in(tmp_path, "fault", """
-            import time
-
             from node_replication_tpu.utils.clock import get_clock
 
             def timed(cond, t):
                 clock = get_clock()
-                t_end = clock.now() + t
+                t0 = clock.now()
                 clock.wait(cond, t)         # routed: receiver is the clock
                 clock.sleep(0.01)
-                t0 = time.perf_counter()    # duration probe: exempt
                 evt_like.join(t)            # thread barrier: exempt
+                return clock.now() - t0
+        """)
+        assert not firing(diags, "raw-clock-in-subsystem")
+
+    def test_perf_counter_duration_probe_fires_in_subsystem(
+            self, tmp_path):
+        # ISSUE 14 satellite: the blanket perf_counter exemption is
+        # narrowed to ops/bench paths — inside a clocked subsystem a
+        # duration probe measured against the OS clock is the
+        # wrong-clock bug (`_run_batch`'s old t0) this rule now flags
+        diags = self._lint_in(tmp_path, "serve", """
+            import time
+
+            def run_batch():
+                t0 = time.perf_counter()
+                do_round()
+                return time.perf_counter() - t0
+        """)
+        assert len(firing(diags, "raw-clock-in-subsystem")) == 2
+
+    def test_perf_counter_in_ops_path_clean(self, tmp_path):
+        # ops/ (and bench/harness paths) are outside the rule's path
+        # scope: kernel calibration timing legitimately reads the OS
+        # clock there
+        diags = self._lint_in(tmp_path, "ops", """
+            import time
+
+            def calibrate():
+                t0 = time.perf_counter()
+                launch()
                 return time.perf_counter() - t0
         """)
         assert not firing(diags, "raw-clock-in-subsystem")
@@ -1327,3 +1354,74 @@ class TestUnboundedMetricCardinality:
                 reg.counter(f"x.{pos}").inc()  # nrlint: disable=unbounded-metric-cardinality — fixture
         """)
         assert not firing(diags, "unbounded-metric-cardinality")
+
+
+class TestDeviceSyncInAssembly:
+    """Rule 19 (ISSUE 14): host syncs on the serve pipeline's assembly
+    stage re-serialize exactly the overlap the pipeline exists to buy.
+    Rooted at `_assemble`, closed over same-module helpers (the
+    blocking-in-handler closure machinery)."""
+
+    def test_item_in_assemble_fires(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            class Frontend:
+                def _assemble(self, rid, q, batch):
+                    depth = self._nr.log.tail.item()
+                    return depth
+        """)
+        assert len(firing(diags, "device-sync-in-assembly")) == 1
+
+    def test_blocking_helper_via_closure_fires(self, tmp_path):
+        # a helper reachable from _assemble is still assembly-stage
+        # code: delegating the device_get does not launder it
+        diags = lint_src(tmp_path, """
+            import jax
+
+            class Frontend:
+                def _peek(self, arr):
+                    return jax.device_get(arr)
+
+                def _assemble(self, rid, q, batch):
+                    return self._peek(batch)
+        """)
+        assert len(firing(diags, "device-sync-in-assembly")) == 1
+
+    def test_future_result_in_assemble_fires(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            class Frontend:
+                def _assemble(self, rid, q, batch):
+                    return batch[0].future.result()
+        """)
+        assert len(firing(diags, "device-sync-in-assembly")) == 1
+
+    def test_clean_assembly_and_out_of_closure_sync_clean(
+            self, tmp_path):
+        # the real assembly shape (sweep + begin + handoff) is clean,
+        # and a sync in the COMPLETION stage — not reachable from
+        # _assemble — is exactly where the wait belongs
+        diags = lint_src(tmp_path, """
+            class Frontend:
+                def _sweep(self, batch):
+                    return [r for r in batch if r.live]
+
+                def _assemble(self, rid, q, batch):
+                    live = self._sweep(batch)
+                    return self._nr.begin_mut_batch(
+                        [r.op for r in live], rid
+                    )
+
+                def _complete(self, rid, q, staged):
+                    resps = self._nr.finish_mut_batch(staged.pending)
+                    return [int(r) for r in resps]
+
+                def _deliver(self, arr):
+                    return arr.item()  # completion-side: fine
+        """)
+        assert not firing(diags, "device-sync-in-assembly")
+
+    def test_module_without_assemble_clean(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            def worker(arr):
+                return arr.item()
+        """)
+        assert not firing(diags, "device-sync-in-assembly")

@@ -732,3 +732,437 @@ class TestServeMetricsAndTrace:
                 reg.disable()
             if not was_tracing:
                 tracer.disable()
+
+
+class TestSplitRoundProtocol:
+    """begin_mut_batch / finish_mut_batch (ISSUE 14): the wrapper half
+    of pipelined serving."""
+
+    def test_begin_finish_responses_in_order(self):
+        nr = small_nr(make_seqreg(4))
+        pending = nr.begin_mut_batch(
+            [(SR_SET, 0, i + 1) for i in range(20)], rid=0
+        )
+        assert nr.finish_mut_batch(pending) == list(range(20))
+        nr.sync()
+        assert nr.replicas_equal()
+
+    def test_at_most_one_round_in_flight(self):
+        nr = small_nr(make_seqreg(2))
+        pending = nr.begin_mut_batch([(SR_SET, 0, 1)], rid=0)
+        with pytest.raises(RuntimeError):
+            nr.begin_mut_batch([(SR_SET, 0, 2)], rid=0)
+        assert nr.finish_mut_batch(pending) == [0]
+        # finished: the slot is free again
+        p2 = nr.begin_mut_batch([(SR_SET, 0, 2)], rid=0)
+        assert nr.finish_mut_batch(p2) == [1]
+
+    def test_finish_twice_raises(self):
+        nr = small_nr(make_seqreg(2))
+        pending = nr.begin_mut_batch([(SR_SET, 0, 1)], rid=0)
+        nr.finish_mut_batch(pending)
+        with pytest.raises(RuntimeError):
+            nr.finish_mut_batch(pending)
+
+    def test_empty_begin_finish(self):
+        nr = small_nr()
+        pending = nr.begin_mut_batch([], rid=0)
+        assert nr.finish_mut_batch(pending) == []
+
+    def test_failed_finish_hygiene(self, monkeypatch):
+        # a replay failure in finish must not poison the next batch
+        # (the execute_mut_batch hygiene regression, split shape)
+        nr = small_nr(make_seqreg(2))
+        orig = NodeReplicated._exec_round
+        state = {"fail": True}
+
+        def flaky(self_nr):
+            if state["fail"]:
+                state["fail"] = False
+                raise RuntimeError("injected replay failure")
+            return orig(self_nr)
+
+        monkeypatch.setattr(NodeReplicated, "_exec_round", flaky)
+        pending = nr.begin_mut_batch(
+            [(SR_SET, 0, i + 1) for i in range(5)], rid=0
+        )
+        with pytest.raises(RuntimeError):
+            nr.finish_mut_batch(pending)
+        # the appended ops replay; the next batch's responses are
+        # exactly its own
+        resps = nr.execute_mut_batch(
+            [(SR_SET, 0, i + 6) for i in range(5)], rid=0
+        )
+        assert resps == [5, 6, 7, 8, 9]
+
+    def test_abort_releases_the_slot(self):
+        nr = small_nr(make_seqreg(2))
+        pending = nr.begin_mut_batch([(SR_SET, 0, 1)], rid=0)
+        nr.abort_mut_batch(pending)
+        nr.abort_mut_batch(pending)  # idempotent
+        # the aborted round's op IS in the log and replays; only its
+        # response was dropped — the next round sees its effect
+        resps = nr.execute_mut_batch([(SR_SET, 0, 2)], rid=0)
+        assert resps == [1]
+
+    def test_cnr_begin_finish_scatter(self):
+        ml = MultiLogReplicated(
+            make_seqreg(4), lambda opc, args: args[0], nlogs=2,
+            n_replicas=2, log_entries=128, gc_slack=8, exec_window=16,
+        )
+        ops, expect = [], []
+        counts = [0, 0, 0, 0]
+        for i in range(16):
+            slot = i % 4
+            ops.append((SR_SET, slot, counts[slot] + 1))
+            expect.append(counts[slot])
+            counts[slot] += 1
+        pending = ml.begin_mut_batch(ops, rid=0)
+        with pytest.raises(RuntimeError):
+            ml.begin_mut_batch([(SR_SET, 0, 99)], rid=0)
+        assert ml.finish_mut_batch(pending) == expect
+        ml.sync()
+        assert ml.replicas_equal()
+
+
+class TestPipelinedServing:
+    """ServeConfig.pipeline_depth=1 (ISSUE 14): the assembly /
+    completion split, overlap semantics, and its failure discipline."""
+
+    def test_depth_validation(self):
+        with pytest.raises(ValueError):
+            ServeConfig(pipeline_depth=2)
+        with pytest.raises(ValueError):
+            ServeConfig(pipeline_depth=-1)
+
+    def test_pipelined_sequence_exact(self):
+        nr = small_nr(make_seqreg(2))
+        fe = ServeFrontend(
+            nr, fast_cfg(pipeline_depth=1, batch_max_ops=8)
+        )
+        futs = [fe.submit((SR_SET, 0, i + 1), rid=0)
+                for i in range(200)]
+        assert [f.result(60.0) for f in futs] == list(range(200))
+        st = fe.stats()
+        assert st["completed"] == 200 and st["in_service"] == 0
+        fe.close()
+        nr.sync()
+        assert nr.replicas_equal()
+
+    def test_depth0_and_depth1_logs_bit_identical(self):
+        # the acceptance pin: same ops through both worker shapes ->
+        # same responses AND same log contents (ring_slice)
+        from node_replication_tpu.core.log import ring_slice
+
+        outs, slices = [], []
+        for depth in (0, 1):
+            nr = small_nr(make_seqreg(4))
+            fe = ServeFrontend(
+                nr, fast_cfg(pipeline_depth=depth, batch_max_ops=4)
+            )
+            futs = [fe.submit((SR_SET, i % 4, i + 1), rid=0)
+                    for i in range(64)]
+            outs.append([f.result(60.0) for f in futs])
+            fe.close()
+            nr.sync()
+            slices.append(ring_slice(nr.spec, nr.log, 0,
+                                     int(nr.log.tail)))
+        assert outs[0] == outs[1]
+        ops0, ops1 = slices
+        assert (ops0[0] == ops1[0]).all() and (ops0[1] == ops1[1]).all()
+
+    def test_close_drain_waits_for_inflight_round(self):
+        nr = small_nr(make_seqreg(2))
+        fe = ServeFrontend(
+            nr, fast_cfg(pipeline_depth=1, batch_max_ops=4)
+        )
+        futs = [fe.submit((SR_SET, 1, i + 1), rid=1)
+                for i in range(40)]
+        fe.close()  # drain=True must flush assembled AND in-flight
+        assert [f.result(0.0) for f in futs] == list(range(40))
+
+    def test_worker_death_with_round_in_flight(self):
+        # the two-stage failover pin: the in-flight round's futures
+        # get post-append ReplicaFailed (maybe_executed=True), the
+        # not-yet-begun round's get pre-append retryable
+        from node_replication_tpu.fault.inject import (
+            FaultPlan,
+            FaultSpec,
+        )
+        from node_replication_tpu.serve import ReplicaFailed
+
+        nr = small_nr(make_seqreg(2), n_replicas=1)
+        fe = ServeFrontend(
+            nr, fast_cfg(pipeline_depth=1, batch_max_ops=2,
+                         failover=True),
+            auto_start=False,
+        )
+        futs = [fe.submit((SR_SET, 0, i + 1), rid=0)
+                for i in range(4)]
+        plan = FaultPlan([
+            FaultSpec(site="serve-complete", action="raise")
+        ])
+        with plan.armed():
+            fe.start()
+            excs = [f.exception(30.0) for f in futs]
+        assert all(isinstance(e, ReplicaFailed) for e in excs)
+        # first batch (2 ops) was in flight: post-append
+        assert [e.maybe_executed for e in excs[:2]] == [True, True]
+        # the rest never reached begin: exactly-once retryable
+        assert [e.maybe_executed for e in excs[2:]] == [False, False]
+        fe.close()
+
+    def test_pre_append_kill_retryable_in_assembly_stage(self):
+        # serve-batch fires in the ASSEMBLY stage pre-append: a kill
+        # there must stay exactly-once retryable (both-stages pin)
+        from node_replication_tpu.fault.inject import (
+            FaultPlan,
+            FaultSpec,
+        )
+        from node_replication_tpu.serve import ReplicaFailed
+
+        nr = small_nr(make_seqreg(2), n_replicas=1)
+        fe = ServeFrontend(
+            nr, fast_cfg(pipeline_depth=1, failover=True),
+            auto_start=False,
+        )
+        fut = fe.submit((SR_SET, 0, 1), rid=0)
+        plan = FaultPlan([
+            FaultSpec(site="serve-batch", action="raise")
+        ])
+        with plan.armed():
+            fe.start()
+            exc = fut.exception(30.0)
+        assert isinstance(exc, ReplicaFailed)
+        assert exc.maybe_executed is False
+        # the op provably never reached the log
+        assert int(nr.log.tail) == 0
+        fe.close()
+
+    def test_deadline_late_success_counted_and_delivered(self):
+        # a request that expires while its round is in flight still
+        # resolves (first resolution wins, the op executed) but lands
+        # in serve.deadline_late_success — SLO honesty
+        from node_replication_tpu.fault.inject import (
+            FaultPlan,
+            FaultSpec,
+        )
+        from node_replication_tpu.obs.metrics import get_registry
+
+        reg = get_registry()
+        was = reg.enabled
+        reg.enable()
+        try:
+            base = reg.counter("serve.deadline_late_success").value
+            nr = small_nr(make_seqreg(2))
+            fe = ServeFrontend(
+                nr, fast_cfg(pipeline_depth=1), auto_start=False
+            )
+            fut = fe.submit((SR_SET, 0, 7), rid=0, deadline_s=0.05)
+            # stall the completion stage past the deadline: the round
+            # is begun (appended) when the stall fires
+            plan = FaultPlan([
+                FaultSpec(site="serve-complete", action="stall",
+                          stall_s=0.5)
+            ])
+            with plan.armed():
+                fe.start()
+                assert fut.result(30.0) == 0  # delivered, not dropped
+            assert (reg.counter("serve.deadline_late_success").value
+                    - base) == 1
+            assert fe.stats()["deadline_missed"] == 0
+            fe.close()
+        finally:
+            if not was:
+                reg.disable()
+
+    def test_grow_mid_traffic_pipelined(self):
+        # elasticity under the two-stage worker: sequences stay exact
+        # across a grow() while pipelined traffic is in flight
+        nr = small_nr(
+            make_seqreg(4), n_replicas=2,
+            log_entries=4096, gc_slack=256, exec_window=256,
+        )
+        fe = ServeFrontend(
+            nr, fast_cfg(queue_depth=256, batch_max_ops=16,
+                         pipeline_depth=1)
+        )
+        errors = []
+
+        def client(c):
+            try:
+                for i in range(200):
+                    resp = fe.submit(
+                        (SR_SET, c, i + 1), rid=c % 2
+                    ).result(60.0)
+                    if resp != i:
+                        errors.append((c, i, resp))
+                        return
+                    if c == 0 and i == 100:
+                        fe.grow(1)
+            except Exception as e:  # pragma: no cover
+                errors.append((c, type(e).__name__, str(e)))
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors, errors[:3]
+        assert nr.n_replicas == 3
+        # the grown replica serves pipelined rounds too (client 0
+        # wrote 1..200, so the fetch-and-set returns 200)
+        assert fe.call((SR_SET, 0, 202), rid=2, timeout=30.0) == 200
+        fe.close()
+        nr.sync()
+        assert nr.replicas_equal()
+
+    def test_cnr_pipelined_frontend(self):
+        ml = MultiLogReplicated(
+            make_seqreg(4), lambda opc, args: args[0], nlogs=2,
+            n_replicas=2, log_entries=128, gc_slack=8, exec_window=16,
+        )
+        with ServeFrontend(
+            ml, fast_cfg(pipeline_depth=1, batch_max_ops=4)
+        ) as fe:
+            futs = [fe.submit((SR_SET, i % 4, i // 4 + 1),
+                              rid=i % 2) for i in range(32)]
+            for i, f in enumerate(futs):
+                assert f.result(30.0) == i // 4
+            assert fe.read((SR_GET, 2), rid=1) == 8
+
+    def test_simclock_pipelined_handoff(self):
+        # the two-stage handoff under virtual time: every wait in the
+        # channel and queue routes through the injectable clock, so a
+        # SimClock(auto_advance) run completes without real sleeps
+        from node_replication_tpu.utils.clock import (
+            SimClock,
+            installed,
+        )
+
+        with installed(SimClock(auto_advance=True)):
+            nr = small_nr(make_seqreg(2))
+            fe = ServeFrontend(
+                nr, fast_cfg(pipeline_depth=1, batch_max_ops=4)
+            )
+            futs = [fe.submit((SR_SET, 0, i + 1), rid=0)
+                    for i in range(24)]
+            assert [f.result(60.0) for f in futs] == list(range(24))
+            fe.close()
+
+    def test_serve_assemble_event_and_report_line(self):
+        from node_replication_tpu.obs.report import analyze, render
+        from node_replication_tpu.utils.trace import get_tracer
+
+        tracer = get_tracer()
+        was = tracer.enabled
+        tracer.enable(None)  # memory ring
+        try:
+            nr = small_nr(make_seqreg(2))
+            fe = ServeFrontend(
+                nr, fast_cfg(pipeline_depth=1, batch_max_ops=8)
+            )
+            futs = [fe.submit((SR_SET, 0, i + 1), rid=0)
+                    for i in range(40)]
+            for f in futs:
+                f.result(30.0)
+            fe.close()
+            events = tracer.events()
+            assert any(e.get("event") == "serve-assemble"
+                       for e in events)
+            rep = analyze(events)
+            pipe = rep["serve"]["pipeline"]
+            assert pipe is not None
+            assert pipe["assemble_events"] >= 1
+            assert pipe["device_busy_s"] >= 0.0
+            import io
+
+            out = io.StringIO()
+            render(rep, out=out)
+            assert "pipeline overlap" in out.getvalue()
+        finally:
+            if not was:
+                tracer.disable()
+
+
+class TestPipelinedFailurePaths:
+    """Review-hardening regressions: the pipelined failure paths that
+    the first cut left untested."""
+
+    def test_non_failover_finish_failure_keeps_serving(self):
+        # a completion-stage failure WITHOUT failover must reject its
+        # own round and keep the pipeline alive (the channel's busy
+        # flag releases; a wedged channel would hang every later op)
+        from node_replication_tpu.fault.inject import (
+            FaultError,
+            FaultPlan,
+            FaultSpec,
+        )
+
+        nr = small_nr(make_seqreg(2), n_replicas=1)
+        fe = ServeFrontend(
+            nr, fast_cfg(pipeline_depth=1, batch_max_ops=4),
+            auto_start=False,
+        )
+        doomed = fe.submit((SR_SET, 0, 1), rid=0)
+        plan = FaultPlan([
+            FaultSpec(site="serve-complete", action="raise")
+        ])
+        with plan.armed():
+            fe.start()
+            with pytest.raises(FaultError):
+                doomed.result(30.0)
+        # the frontend still serves — and the wrapper's in-flight slot
+        # was released, so the next round begins cleanly. The doomed
+        # op's append DID land (post-append failure), so the register
+        # already moved to 1.
+        assert fe.call((SR_SET, 0, 2), rid=0, timeout=30.0) == 1
+        assert fe.call((SR_SET, 0, 3), rid=0, timeout=30.0) == 2
+        fe.close()
+
+    def test_failover_completion_kill_then_restart_serves(self):
+        # the completion-stage kill fires BEFORE finish_mut_batch, so
+        # the begun round must be aborted during failover — otherwise
+        # restart_replica yields a replica whose first begin refuses
+        # forever ("already has a round in flight")
+        from node_replication_tpu.fault.inject import (
+            FaultPlan,
+            FaultSpec,
+        )
+        from node_replication_tpu.serve import ReplicaFailed
+
+        nr = small_nr(make_seqreg(2), n_replicas=1)
+        fe = ServeFrontend(
+            nr, fast_cfg(pipeline_depth=1, batch_max_ops=4,
+                         failover=True),
+            auto_start=False,
+        )
+        doomed = fe.submit((SR_SET, 0, 1), rid=0)
+        plan = FaultPlan([
+            FaultSpec(site="serve-complete", action="raise")
+        ])
+        with plan.armed():
+            fe.start()
+            exc = doomed.exception(30.0)
+        assert isinstance(exc, ReplicaFailed) and exc.maybe_executed
+        # restart WITHOUT the lifecycle manager's fence/repair cycle
+        # (the path that cannot rely on fence_replica's cleanup)
+        fe.restart_replica(0)
+        # the killed round's op was appended and replays: register is 1
+        assert fe.call((SR_SET, 0, 2), rid=0, timeout=30.0) == 1
+        fe.close()
+
+    def test_cnr_serial_batch_refused_while_split_in_flight(self):
+        ml = MultiLogReplicated(
+            make_seqreg(4), lambda opc, args: args[0], nlogs=2,
+            n_replicas=1, log_entries=128, gc_slack=8, exec_window=16,
+        )
+        pending = ml.begin_mut_batch(
+            [(SR_SET, 0, 1), (SR_SET, 1, 1)], rid=0
+        )
+        with pytest.raises(RuntimeError):
+            ml.execute_mut_batch([(SR_SET, 2, 1)], rid=0)
+        assert ml.finish_mut_batch(pending) == [0, 0]
+        # with the split round finished, serial batches run again
+        assert ml.execute_mut_batch([(SR_SET, 2, 1)], rid=0) == [0]

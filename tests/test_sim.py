@@ -332,3 +332,36 @@ class TestCanaries:
     def test_unknown_canary_raises(self):
         with pytest.raises(ValueError):
             canary.armed("no-such-bug")
+
+class TestPipelineOverlapKnob:
+    def test_overlap_drawn_for_serve_flavor_only(self):
+        # ISSUE 14: the serve flavor's overlap knob covers depth-1
+        # pipelining in the sweep; every other flavor stays serial,
+        # and the FRESH rng stream keeps base schedules byte-identical
+        seen = {0: 0, 1: 0}
+        for seed in range(120):
+            spec = generate_case(seed)
+            if spec.flavor == "serve":
+                seen[spec.overlap] += 1
+            else:
+                assert spec.overlap == 0
+        assert seen[0] > 0 and seen[1] > 0
+
+    def test_pipelined_serve_case_clean_and_deterministic(self):
+        spec = _find_spec(
+            lambda s: s.overlap == 1, flavors=("serve",),
+        )
+        assert spec.overlap == 1
+        r1 = run_case(spec)
+        assert r1.ok, [v.as_dict() for v in r1.violations]
+        r2 = run_case(spec)
+        assert r1.digest == r2.digest
+
+    def test_overlap_field_optional_in_artifacts(self):
+        # pre-overlap failing-seed artifacts (no "overlap" key) must
+        # keep loading and replaying
+        spec = generate_case(3)
+        d = spec.as_dict()
+        d.pop("overlap")
+        loaded = CaseSpec.from_dict(d)
+        assert loaded.overlap == 0
