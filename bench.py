@@ -284,6 +284,16 @@ def kernel_main(args) -> int:
     `pallas_fused >= combined` dispatches/s — the ROADMAP item-1
     target; off-TPU (or `--kernel-interpret`) the throughput gate
     self-skips, matching the `--mesh` baseline-gate convention.
+
+    `--kernel-devices N` (default 1) re-points the sweep at the MESH
+    tiers: `mesh_fused` (the shard_map-wrapped one-launch round,
+    `parallel/collectives.py:MeshFusedEngine`) vs the `shmap`
+    append+exec chain at N devices, still bit-identity-verified
+    against the 1-device scan chain; the flagship TPU gate becomes
+    `mesh_fused >= shmap`. `launches_per_round` in the CSV is derived
+    from the `kernel.launches` counter delta, so the
+    one-launch-per-round claim is measured, not asserted — and must
+    hold as devices scale.
     """
     from node_replication_tpu.harness.mkbench import (
         append_kernel_csv,
@@ -293,6 +303,7 @@ def kernel_main(args) -> int:
 
     on_tpu = jax.devices()[0].platform == "tpu"
     interpret = args.kernel_interpret or not on_tpu
+    devices = args.kernel_devices
     failures: list[str] = []
     results = []
     csv_rows: list[dict] = []
@@ -305,6 +316,7 @@ def kernel_main(args) -> int:
             points = measure_kernel(
                 K, R, W, duration_s=args.kernel_duration,
                 interpret=interpret, seed=args.seed,
+                devices=devices,
             )
         except ValueError as e:
             failures.append(f"{spec_str}: {e}")
@@ -319,16 +331,22 @@ def kernel_main(args) -> int:
         gate = None
         flagship = (R, K) == (4096, 10_000)
         if flagship and not interpret:
-            fused = by_tier["pallas_fused"].dispatches_per_sec
-            comb = by_tier["combined"].dispatches_per_sec
+            fused_tier, chain_tier = (
+                ("mesh_fused", "shmap") if devices > 1
+                else ("pallas_fused", "combined")
+            )
+            fused = by_tier[fused_tier].dispatches_per_sec
+            comb = by_tier[chain_tier].dispatches_per_sec
             gate = fused >= comb
             if not gate:
                 failures.append(
-                    f"{spec_str}: fused {fused:.3g} dispatches/s < "
-                    f"combined {comb:.3g} on the flagship config"
+                    f"{spec_str}: {fused_tier} {fused:.3g} "
+                    f"dispatches/s < {chain_tier} {comb:.3g} on the "
+                    f"flagship config"
                 )
         results.append({
             "point": spec_str.strip(),
+            "devices": devices,
             "flagship": flagship,
             "tiers": {
                 p.tier: {
@@ -341,7 +359,7 @@ def kernel_main(args) -> int:
                     "bit_identical": p.bit_identical,
                 } for p in points
             },
-            "fused_vs_combined_gate": gate,
+            "fused_vs_chain_gate": gate,
         })
         csv_rows.extend(kernel_rows(f"bench/{spec_str.strip()}", points))
     append_kernel_csv(args.serve_out, csv_rows)
@@ -350,6 +368,7 @@ def kernel_main(args) -> int:
         "value": len(results),
         "unit": "points",
         "interpret": interpret,
+        "devices": devices,
         "throughput_gate": (
             "enforced" if (on_tpu and not interpret) else "skipped"
         ),
@@ -394,12 +413,24 @@ def mesh_main(args) -> int:
       flagship), so the mesh work cannot silently regress the
       single-chip number the scaling claims are relative to. Skipped
       on CPU/forced-host meshes, where the absolute number is
-      meaningless (`--mesh-baseline 0` disables it everywhere).
+      meaningless (`--mesh-baseline 0` disables it everywhere);
+    - **mesh-fused wins at every width** — the per-width exec-TIER
+      column: at each multi-device width the combiner-round pair
+      {`mesh_fused` (one shard_map-wrapped launch per device,
+      `parallel/collectives.py:MeshFusedEngine`), `shmap` (the PR 9
+      append+exec chain)} is measured at `--mesh-window` with
+      bit-identity vs the 1-DEVICE scan chain verified before timing
+      at every point (enforced everywhere); on TPU at the flagship
+      4096×10000 config, `mesh_fused >= shmap` must hold at EVERY
+      width — the "one launch per round at every mesh width" claim,
+      with `launches_per_round` counter-derived in the CSV.
     """
     from node_replication_tpu.harness.mkbench import (
         append_mesh_csv,
+        measure_kernel,
         measure_mesh,
         mesh_rows,
+        mesh_tier_rows,
     )
     from node_replication_tpu.models import (
         HM_GET,
@@ -465,10 +496,64 @@ def mesh_main(args) -> int:
                 f"number; re-baseline deliberately)"
             )
 
+    # ---- per-width exec-TIER column: mesh_fused vs shmap ----------
+    # (combiner-round engines at each multi-device width; bit-identity
+    # vs the 1-device scan chain enforced everywhere, the
+    # mesh_fused >= shmap throughput gate on TPU at the flagship
+    # config — the mesh-fused acceptance contract)
+    interpret = platform != "tpu"
+    tier_gate_active = (
+        not interpret and (R, args.keys) == (4096, 10_000)
+    )
+    tier_curve = []
+    tier_csv_rows: list[dict] = []
+    W = args.mesh_window
+    for c in counts:
+        if c < 2:
+            continue  # the tier pair needs a mesh; 1-device is the
+            # --kernel flagship sweep's job
+        try:
+            tpts = measure_kernel(
+                args.keys, R, W, duration_s=args.mesh_duration,
+                interpret=interpret, seed=args.seed, devices=c,
+            )
+        except ValueError as e:
+            failures.append(f"tier column at {c} devices: {e}")
+            continue
+        by_tier = {p.tier: p for p in tpts}
+        for p in tpts:
+            if not p.bit_identical:
+                failures.append(
+                    f"tier {p.tier} at {p.devices} devices is NOT "
+                    f"bit-identical to the 1-device scan chain"
+                )
+        if tier_gate_active:
+            fused = by_tier["mesh_fused"].dispatches_per_sec
+            shmap = by_tier["shmap"].dispatches_per_sec
+            if fused < shmap:
+                failures.append(
+                    f"mesh_fused {fused:.3g} dispatches/s < shmap "
+                    f"{shmap:.3g} at {c} devices (the one-launch "
+                    f"tier must win at every width on the flagship "
+                    f"config)"
+                )
+        tier_curve.append({
+            "devices": c,
+            "window": W,
+            "tiers": {
+                p.tier: {
+                    "throughput_dps": round(p.dispatches_per_sec, 1),
+                    "launches_per_round": p.launches_per_round,
+                    "bit_identical": p.bit_identical,
+                } for p in tpts
+            },
+        })
+        tier_csv_rows.extend(mesh_tier_rows("bench", W, tpts))
+
     batch = args.writes_per_replica + args.reads_per_replica
     rows = mesh_rows("bench", points, batch=batch, keys=args.keys,
                      replicas=R)
-    append_mesh_csv(args.serve_out, rows)
+    append_mesh_csv(args.serve_out, rows + tier_csv_rows)
     base = points[0].result.mops or 1e-9
     curve = [{
         "devices": p.devices,
@@ -497,6 +582,12 @@ def mesh_main(args) -> int:
             "enforced" if gate_active else "skipped (non-TPU)"
         ),
         "curve": curve,
+        "tier_window": W,
+        "tier_gate": (
+            "enforced" if tier_gate_active
+            else "skipped (non-TPU or non-flagship)"
+        ),
+        "tier_curve": tier_curve,
         "bit_identical": all(p.bit_identical for p in points),
     }))
     if failures:
@@ -2800,6 +2891,13 @@ def main():
                         help="force interpret-mode kernels (the CPU CI "
                              "bit-identity pass; throughput gate "
                              "self-skips)")
+    kernel.add_argument("--kernel-devices", type=int, default=1,
+                        help="measure the MESH tier pair (mesh_fused "
+                             "vs shmap) at N devices instead of the "
+                             "single-device tiers; launches_per_round "
+                             "in the CSV is counter-derived, so the "
+                             "one-launch claim is checked as devices "
+                             "scale")
     mesh = p.add_argument_group(
         "mesh", "mesh scaling benchmark (--mesh): the flagship "
                 "hashmap 50/50 config at 1→N devices with the "
@@ -2818,6 +2916,10 @@ def main():
                            "1 is always included as the curve base)")
     mesh.add_argument("--mesh-duration", type=float, default=1.0,
                       help="seconds of timed stepping per point")
+    mesh.add_argument("--mesh-window", type=int, default=4096,
+                      help="combiner-round window of the per-width "
+                           "exec-tier column (mesh_fused vs shmap; "
+                           "the flagship --kernel window by default)")
     mesh.add_argument("--mesh-baseline", type=float, default=6.94e9,
                       help="flagship dispatches/s the 1-device point "
                            "is gated against on TPU (r05 committed "

@@ -1542,6 +1542,49 @@ def _grid_is_single(node: ast.AST | None,
     return False
 
 
+def _closure_aliases(mod: ModuleInfo,
+                     node: ast.AST) -> dict[str, ast.AST]:
+    """`_local_aliases` extended through the WHOLE lexical closure:
+    name -> value expr from every enclosing function, innermost scope
+    shadowing outermost. The shard_map-wrapped kernel builders need
+    this — the `pl.pallas_call` lives in a nested shard-local function
+    while its `grid`/`in_specs`/`input_output_aliases` are bound in
+    the enclosing builder, so one-level (innermost-only) resolution
+    sees nothing and the rule would stay silent on exactly the
+    mesh-wrapped variant of the race. Within one function the LAST
+    assignment in source order wins (`_local_aliases` parity — a
+    rebound `grid = (1,)` → `grid = (R // tile,)` must resolve to the
+    multi-step value or the ERROR rule goes silent on a real race),
+    and nested function bodies are skipped when scanning an enclosing
+    scope: a sibling inner def's bindings are its own, not the
+    closure's."""
+    def scope_assigns(fn) -> dict[str, ast.AST]:
+        local: dict[str, ast.AST] = {}
+
+        def visit(children) -> None:
+            for n in children:
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # inner scopes bind their own names
+                if (
+                    isinstance(n, ast.Assign)
+                    and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                ):
+                    local[n.targets[0].id] = n.value
+                visit(ast.iter_child_nodes(n))
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        visit(body)
+        return local
+
+    out: dict[str, ast.AST] = {}
+    for fn in mod.enclosing_functions(node):
+        for k, v in scope_assigns(fn).items():
+            out.setdefault(k, v)  # innermost scope wins
+    return out
+
+
 @rule(
     "aliased-pallas-planes", ERROR,
     "input_output_aliases on a blocked state plane of a multi-step-grid "
@@ -1560,8 +1603,12 @@ def aliased_pallas_planes(mod: ModuleInfo,
     kernels), aliasing under `grid=(1,)` (the plan kernels — one grid
     step, no pipeline), and aliasing of UN-BLOCKED refs
     (`memory_space=ANY/HBM` moved by explicit DMA — the fused round's
-    ring planes, `ops/pallas_ring.py`). Scoped to ops/, where every
-    kernel lives."""
+    ring planes, `ops/pallas_ring.py`). Covers the shard_map-wrapped
+    variant too: names resolve through the whole lexical closure
+    (`_closure_aliases`) and the alias map may itself be bound to a
+    name, so a builder that constructs the call inside a nested
+    shard-local function — the mesh-fused idiom — is checked exactly
+    like a flat one. Scoped to ops/, where every kernel lives."""
     parts = re.split(r"[\\/]+", mod.path)
     if "ops" not in parts[:-1]:
         return
@@ -1576,10 +1623,12 @@ def aliased_pallas_planes(mod: ModuleInfo,
         if name != "pallas_call":
             continue
         kw = {k.arg: k.value for k in node.keywords if k.arg}
+        aliases = _closure_aliases(mod, node)
         al = kw.get("input_output_aliases")
+        if isinstance(al, ast.Name) and al.id in aliases:
+            al = aliases[al.id]
         if not isinstance(al, ast.Dict):
             continue
-        aliases = _local_aliases(mod, node)
         if _grid_is_single(kw.get("grid"), aliases):
             continue
         in_specs = kw.get("in_specs")

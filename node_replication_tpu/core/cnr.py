@@ -455,8 +455,7 @@ class MultiLogReplicated(_FusedTier):
         if not (int(lts.min()) == tail == int(lts.max())):
             self._m_fused_fallback.inc()
             return False
-        timing = (self._fused_mode == "auto"
-                  and self._fused_choice is None)
+        timing = self._fused_calibrating()
         t0 = time.perf_counter()
         fn = self._fused_cnr_round(eng, pad)
         extra = {"deferred": True} if pending is not None else {}
@@ -519,8 +518,7 @@ class MultiLogReplicated(_FusedTier):
         opcodes, args, _ = encode_ops(
             ops, self.spec.arg_width, pad_to=pad
         )
-        timing = (self._fused_mode == "auto"
-                  and self._fused_choice is None)
+        timing = self._fused_calibrating()
         defer = defer and not timing
         pending = _PendingRound(rid, list(tids), n, pos0, batch=batch,
                                 log_idx=log_idx)

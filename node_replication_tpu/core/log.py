@@ -80,6 +80,11 @@ _m_engine_shmap = get_registry().counter("log.engine.shmap")
 # boundary — fused rounds are host-invoked, so unlike the per-trace
 # counters above this one is an exact round count.
 _m_engine_pallas_fused = get_registry().counter("log.engine.pallas_fused")
+# mesh-fused tier: the same one-launch fused round embedded in a
+# shard_map program over the replica mesh
+# (`parallel/collectives.py:MeshFusedEngine`) — exact per-round host
+# count, like the pallas_fused counter above.
+_m_engine_mesh_fused = get_registry().counter("log.engine.mesh_fused")
 
 # Default number of log entries. The reference defaults to 32 MiB of 64-byte
 # entries = 2^19 slots "based on the ASPLOS 2017 paper" (`nr/src/log.rs:19-22`);

@@ -148,7 +148,8 @@ class _PendingRound:
     """
 
     __slots__ = ("rid", "tids", "n", "pos0", "target", "batch",
-                 "log_idx", "fused_resps", "done", "t_chain", "pad")
+                 "log_idx", "fused_resps", "done", "t_chain", "pad",
+                 "fkey", "tier")
 
     def __init__(self, rid: int, tids: list[int], n: int, pos0: int,
                  batch: bool = False, log_idx: int | None = None):
@@ -164,6 +165,10 @@ class _PendingRound:
         self.done = False
         self.t_chain: float | None = None
         self.pad = 0
+        #: calibration fence-mask key at begin (chain samples note it)
+        self.fkey: tuple = ()
+        #: engine tier of a deferred fused launch (readback delivery)
+        self.tier: str | None = None
 
 
 class ReplicaToken(NamedTuple):
@@ -251,35 +256,68 @@ class _FusedTier:
     `MultiLogReplicated` (`core/cnr.py`): lazy spec-bound engine
     construction, the calibration sampler, and the winner-selection
     state machine. Hosts expect the attributes initialized by their
-    constructors (`_fused_mode`, `_fused_choice`, `_fused_samples`,
-    `_fused`, `_fused_spec`) and provide `_fused_log_spec()` — the
-    `LogSpec` the engine is built against (a CNR derives one per-log
-    spec for all its logs). All methods run under the host's combiner
-    lock."""
+    constructors (`_fused_mode`, `_fused_choice`, `_fused_verdicts`,
+    `_fused_samples`, `_fused`, `_fused_spec`) and provide
+    `_fused_log_spec()` — the `LogSpec` the engine is built against (a
+    CNR derives one per-log spec for all its logs). All methods run
+    under the host's combiner lock.
+
+    On a mesh (`NodeReplicated(mesh=)`) the tier is the MESH-FUSED
+    composition (`parallel/collectives.py:MeshFusedEngine`): the same
+    one-launch round wrapped in shard_map with the cursor lattice
+    joined over ICI, competing against the shmap/gspmd chain instead
+    of the single-device one. Calibration is mesh-aware by
+    construction: the verdict is measured at the live (R, capacity,
+    devices) point and reset on `grow_fleet` AND on mesh re-placement
+    (`_place_on_mesh`)."""
 
     def _fused_log_spec(self) -> LogSpec:
         return self.spec
 
+    def _fused_fence_key(self) -> tuple:
+        """Calibration key for the CURRENT quarantine mask: the sorted
+        fenced rids (empty when none). Chain and fused timings are
+        only comparable under the same mask — the fenced kernel
+        variant is a DIFFERENT program — so samples and verdicts are
+        keyed on it: a quarantine mid-serve recalibrates instead of
+        routing rounds through a tier whose fenced variant was never
+        timed."""
+        f = getattr(self, "_fenced", None)
+        if f is None:
+            return ()
+        return tuple(int(r) for r in np.where(f)[0])
+
     def _init_fused_tier(self, engine: str, dispatch, mesh, reg,
-                         prefix: str, debug: bool = False) -> None:
+                         prefix: str, debug: bool = False,
+                         mesh_fused: bool = False) -> None:
         """Initialize the tier state + counters and resolve the mode —
         the one constructor block both wrappers share. `engine='pallas'`
         FORCES the tier (validated loudly here: the model must carry a
-        `fused_factory`, and neither `mesh=` nor checkify `debug` has a
-        fused twin); `engine='auto'` with a fused-capable dispatch arms
-        the measured calibration on TPU (NR_TPU_FUSED_CAL=1 is the
-        CPU-test hook — in interpret mode the fused tier cannot
-        honestly win); anything else leaves the tier off."""
+        `fused_factory`, and checkify `debug` has no fused twin; on a
+        mesh the host must support the mesh-fused composition —
+        `mesh_fused=True`, NodeReplicated only); `engine='auto'` with a
+        fused-capable dispatch arms the measured calibration on TPU
+        (NR_TPU_FUSED_CAL=1 is the CPU-test hook — in interpret mode
+        the fused tier cannot honestly win); anything else leaves the
+        tier off."""
         self._fused = None
         self._fused_spec = None
         self._fused_mode = "off"
         self._fused_choice: bool | None = False
-        # calibration samples are keyed by WINDOW (the padded batch
-        # size): chain and fused timings are only comparable at the
-        # same window, and the per-window warmup absorbs each window's
-        # jit compile — the verdict commits at the first window that
-        # fills both sides (see _note_fused_sample)
-        self._fused_samples: dict[str, dict[int, list]] = {
+        # auto-mode verdicts, keyed by the fence mask (_fused_fence_key)
+        self._fused_verdicts: dict[tuple, bool] = {}
+        self._fused_mesh = mesh if mesh_fused else None
+        self._fused_tier_name = (
+            "mesh_fused" if self._fused_mesh is not None
+            else "pallas_fused"
+        )
+        # calibration samples are keyed by (WINDOW, fence mask): chain
+        # and fused timings are only comparable at the same padded
+        # batch size AND the same quarantine mask, and the per-key
+        # warmup absorbs each program's jit compile — a verdict
+        # commits at the first key that fills both sides (see
+        # _note_fused_sample)
+        self._fused_samples: dict[str, dict[tuple, list]] = {
             "pallas_fused": {}, "chain": {},
         }
         self._fused_rounds = 0
@@ -298,12 +336,12 @@ class _FusedTier:
                     f"engine='pallas' but {dispatch.name} has no "
                     f"fused_factory (no fused kernel for this model)"
                 )
-            if mesh is not None:
+            if mesh is not None and not mesh_fused:
                 raise ValueError(
-                    "engine='pallas' does not take mesh= (the fused "
-                    "tier runs un-meshed; its chunk layout is "
-                    "P('replica')-shardable but the shmap wiring is "
-                    "not routed yet — see README 'Engines')"
+                    "engine='pallas' does not take mesh= here (the "
+                    "mesh-fused composition is NodeReplicated-only; "
+                    "the CNR per-log tier runs un-meshed — see README "
+                    "'Engines')"
                 )
             if debug:
                 raise ValueError(
@@ -314,14 +352,14 @@ class _FusedTier:
             # build eagerly so an unsupported config fails loudly at
             # construction (the explicit ask), not mid-traffic
             spec = self._fused_log_spec()
-            self._fused = dispatch.fused_factory(spec)
+            self._fused = self._build_fused_engine(spec)
             self._fused_spec = spec
             self._fused_mode = "forced"
             self._fused_choice = True
         elif (
             engine == "auto"
             and dispatch.fused_factory is not None
-            and mesh is None
+            and (mesh is None or mesh_fused)
             and not debug
             and (jax.default_backend() == "tpu"
                  or os.environ.get("NR_TPU_FUSED_CAL") == "1")
@@ -329,8 +367,21 @@ class _FusedTier:
             self._fused_mode = "auto"
             self._fused_choice = None  # calibration pending
 
+    def _build_fused_engine(self, spec: LogSpec):
+        """The tier's engine for `spec`: the dispatch's own fused
+        engine un-meshed, the shard_map-wrapped MeshFusedEngine on a
+        mesh. Both raise ValueError for unsupported configs."""
+        if self._fused_mesh is not None:
+            from node_replication_tpu.parallel.collectives import (
+                MeshFusedEngine,
+            )
+
+            return MeshFusedEngine(self.dispatch, spec,
+                                   self._fused_mesh)
+        return self.dispatch.fused_factory(spec)
+
     def _fused_engine(self):
-        """Lazily (re)build the dispatch's fused engine for the CURRENT
+        """Lazily (re)build the tier's fused engine for the CURRENT
         spec (fleet growth rebinds it). A factory rejection after a
         shape change degrades the tier to off with a warning rather
         than killing live traffic."""
@@ -339,7 +390,7 @@ class _FusedTier:
         spec = self._fused_log_spec()
         if self._fused is None or self._fused_spec != spec:
             try:
-                self._fused = self.dispatch.fused_factory(spec)
+                self._fused = self._build_fused_engine(spec)
                 self._fused_spec = spec
             except ValueError as e:
                 logger.warning(
@@ -351,48 +402,102 @@ class _FusedTier:
                 return None
         return self._fused
 
-    def _fused_tier_wanted(self, pad: int):
+    def _fused_calibrating(self, fkey: tuple | None = None) -> bool:
+        """Auto mode with no committed verdict for the CURRENT fence
+        mask — rounds are timed (and `defer` is ignored) while this
+        holds. A fenced mask whose engine has NO fenced variant
+        commits `chain` immediately: there is nothing to measure —
+        `_try_fused_round` would fall back unconditionally — and
+        without the short-circuit the fused side of the (pad, fkey)
+        key could never fill, leaving the wrapper 'calibrating' (defer
+        forced off, the serve pipeline's overlap dead) for the whole
+        quarantine. Callers on the round hot path pass the
+        already-computed `fkey` (the key derivation is an O(R) host
+        scan under the combiner lock — compute it once per round)."""
+        if self._fused_mode != "auto":
+            return False
+        if fkey is None:
+            fkey = self._fused_fence_key()
+        if self._fused_verdicts.get(fkey) is not None:
+            return False
+        if fkey:
+            eng = self._fused_engine()
+            if eng is None or not eng.supports_fenced:
+                self._fused_verdicts[fkey] = False
+                # every verdict commit leaves a trace record — an
+                # operator reading the calibrations section must be
+                # able to tell "measured chain win" from "nothing to
+                # measure under this mask"
+                get_tracer().emit(
+                    "fused-calibration", window=0, fenced=list(fkey),
+                    tier=self._fused_tier_name,
+                    devices=getattr(eng, "devices", 1),
+                    fused_s=0.0, chain_s=0.0, winner="chain",
+                    reason="no-fenced-variant",
+                )
+                return False
+        return True
+
+    def _fused_tier_wanted(self, pad: int,
+                           fkey: tuple | None = None):
         """The engine to route a `pad`-window round through, or None
         for the ordinary chain. During auto calibration the chain goes
-        first AT EACH WINDOW (its programs are the already-compiled
-        steady state), then the fused tier collects that window's own
-        samples — mixing windows would compare incomparable rounds."""
+        first AT EACH (window, fence-mask) key (its programs are the
+        already-compiled steady state), then the fused tier collects
+        that key's own samples — mixing keys would compare
+        incomparable rounds. `fkey` as in `_fused_calibrating`."""
         if self._fused_mode == "off" or self._fused_choice is False:
             return None
-        if self._fused_mode == "auto" and self._fused_choice is None:
-            need = FUSED_CAL_WARMUP + FUSED_CAL_SAMPLES
-            if len(self._fused_samples["chain"].get(pad, ())) < need:
+        if self._fused_mode == "auto":
+            if fkey is None:
+                fkey = self._fused_fence_key()
+            verdict = self._fused_verdicts.get(fkey)
+            if verdict is False:
                 return None
+            if verdict is None:
+                need = FUSED_CAL_WARMUP + FUSED_CAL_SAMPLES
+                chain = self._fused_samples["chain"].get(
+                    (pad, fkey), ()
+                )
+                if len(chain) < need:
+                    return None
         return self._fused_engine()
 
-    def _note_fused_sample(self, tier: str, pad: int,
-                           dt: float) -> None:
+    def _note_fused_sample(self, tier: str, pad: int, dt: float,
+                           fkey: tuple = ()) -> None:
         need = FUSED_CAL_WARMUP + FUSED_CAL_SAMPLES
-        samples = self._fused_samples[tier].setdefault(pad, [])
+        key = (pad, tuple(fkey))
+        samples = self._fused_samples[tier].setdefault(key, [])
         if len(samples) < need:
             samples.append(dt)
-        # the verdict commits at the FIRST window whose chain and
-        # fused sides are both full: same-window samples only, and
-        # each side's warmup absorbed that window's compile
-        chain = self._fused_samples["chain"].get(pad, ())
-        fused = self._fused_samples["pallas_fused"].get(pad, ())
+        # the verdict commits at the FIRST key whose chain and fused
+        # sides are both full: same-window same-mask samples only, and
+        # each side's warmup absorbed that program's compile
+        chain = self._fused_samples["chain"].get(key, ())
+        fused = self._fused_samples["pallas_fused"].get(key, ())
         if len(chain) < need or len(fused) < need:
             return
         med_c = statistics.median(chain[FUSED_CAL_WARMUP:])
         med_f = statistics.median(fused[FUSED_CAL_WARMUP:])
-        self._fused_choice = med_f <= med_c
+        verdict = med_f <= med_c
+        self._fused_verdicts[tuple(fkey)] = verdict
         get_tracer().emit(
-            "fused-calibration", window=pad,
+            "fused-calibration", window=pad, fenced=list(fkey),
+            tier=self._fused_tier_name,
+            devices=getattr(self._fused, "devices", 1),
             fused_s=med_f, chain_s=med_c,
-            winner="pallas_fused" if self._fused_choice else "chain",
+            winner=(
+                self._fused_tier_name if verdict else "chain"
+            ),
         )
 
     def _reset_fused_calibration(self) -> None:
-        """Fleet-shape change under engine='auto': the committed
-        verdict was measured at the OLD (R, capacity) point — drop it
-        and recalibrate at the new one."""
+        """Fleet-shape change (or mesh re-placement) under
+        engine='auto': the committed verdicts were measured at the OLD
+        (R, capacity, devices) point — drop them and recalibrate at
+        the new one."""
         if self._fused_mode == "auto":
-            self._fused_choice = None
+            self._fused_verdicts = {}
             self._fused_samples = {"pallas_fused": {}, "chain": {}}
 
     def round_tier(self, rid: int) -> str | None:
@@ -414,15 +519,19 @@ class _FusedTier:
         return self._pos_by_rid.get(rid)
 
     def _fused_tier_state(self) -> str:
-        """Human-readable fused-tier state for stats()/snapshot()."""
+        """Human-readable fused-tier state for stats()/snapshot() —
+        the verdict for the CURRENT fence mask (auto mode verdicts are
+        per-mask, see `_fused_fence_key`)."""
         if self._fused_mode == "off":
             return "off"
         if self._fused_mode == "forced":
             return "forced"
-        if self._fused_choice is None:
+        verdict = self._fused_verdicts.get(self._fused_fence_key())
+        if verdict is None:
             return "calibrating"
         return (
-            "auto:pallas_fused" if self._fused_choice else "auto:chain"
+            f"auto:{self._fused_tier_name}" if verdict
+            else "auto:chain"
         )
 
 
@@ -569,18 +678,6 @@ class NodeReplicated(_FusedTier):
         # counts per-trace selections of the inner tiers)
         self._m_engine = reg.counter(f"nr.exec.engine.{self.engine}")
 
-        # ---- fused pallas combiner-round tier (ops/pallas_replay) ----
-        # One kernel launch per combiner round: append + replay +
-        # response gather fused into a single program, replacing the
-        # append-jit → exec-jit chain (and its per-round host syncs)
-        # when the round is lock-step eligible. Mode resolution +
-        # winner-selection calibration: `_FusedTier` (shared with the
-        # CNR twin). The tier never changes results — it is
-        # differentially pinned bit-identical to the scan engine
-        # (tests/test_pallas_fused.py) — only the launch count.
-        self._init_fused_tier(engine, dispatch, mesh, reg, "nr",
-                              debug=self.debug)
-
         # ---- mesh placement (parallel/): shard the replica axis -----
         # `mesh` puts the fleet across devices: states (and ltails)
         # shard over the mesh's 'replica' axis, the log's ring arrays
@@ -645,7 +742,31 @@ class NodeReplicated(_FusedTier):
             self._m_mesh_sync_bytes = reg.counter("mesh.sync_bytes")
             self._m_mesh_dur = reg.histogram("mesh.round.duration_s")
             self._m_ring = reg.counter("nr.exec.engine.ring")
+            # mesh-fused rounds (the shard_map-wrapped one-launch tier)
+            # count separately from the shmap/gspmd chain rounds
+            self._m_mesh_fused_round = reg.counter(
+                "nr.exec.mesh.mesh_fused"
+            )
             announce_placement(mesh, n_replicas, "NodeReplicated", tier)
+
+        # ---- fused pallas combiner-round tier (ops/pallas_replay) ----
+        # One kernel launch per combiner round: append + replay +
+        # response gather fused into a single program, replacing the
+        # append-jit → exec-jit chain (and its per-round host syncs)
+        # when the round is lock-step eligible. On a mesh the tier is
+        # the MESH-FUSED composition (`parallel/collectives.py:
+        # MeshFusedEngine`): one shard_map-wrapped launch per device
+        # with the cursor lattice joined over ICI, replacing the
+        # shmap/gspmd chain for eligible rounds. Mode resolution +
+        # winner-selection calibration: `_FusedTier` (shared with the
+        # CNR twin; initialized AFTER mesh normalization so the tier
+        # binds the real Mesh object). The tier never changes results —
+        # it is differentially pinned bit-identical to the scan engine
+        # (tests/test_pallas_fused.py, tests/test_mesh_fleet.py) —
+        # only the launch count.
+        self._init_fused_tier(engine, dispatch, self.mesh, reg, "nr",
+                              debug=self.debug, mesh_fused=True)
+        if self.mesh is not None:
             self._place_on_mesh()
         self._build_jits()
 
@@ -660,6 +781,9 @@ class NodeReplicated(_FusedTier):
         from node_replication_tpu.parallel.mesh import place
 
         self.log, self.states = place(self.log, self.states, self.mesh)
+        # re-placement is a new (R, capacity, devices) point: an
+        # auto-mode winner verdict measured before it no longer applies
+        self._reset_fused_calibration()
 
     def replica_device(self, rid: int):
         """The device hosting replica `rid`'s state shard (None when
@@ -1186,7 +1310,8 @@ class NodeReplicated(_FusedTier):
 
     @_locked
     def _try_fused_round(self, ops, rid, tids, n, pos0, pad,
-                         opcodes, args, pending=None) -> bool:
+                         opcodes, args, pending=None,
+                         fkey: tuple = ()) -> bool:
         """Route one combiner round through the fused engine when
         eligible; False falls back to the append+exec chain. The
         eligibility is exactly the lock-step precondition the fused
@@ -1201,8 +1326,10 @@ class NodeReplicated(_FusedTier):
         kernel is LAUNCHED and journaled here but the response
         readback (the round's host fence) is deferred to
         `_finish_round`: the whole device round overlaps whatever host
-        work the caller does between begin and finish."""
-        eng = self._fused_tier_wanted(pad)
+        work the caller does between begin and finish. `fkey` is the
+        round's fence-mask calibration key, computed once by
+        `_begin_round`."""
+        eng = self._fused_tier_wanted(pad, fkey)
         if eng is None:
             return False
         if self._fenced is not None and not eng.supports_fenced:
@@ -1224,11 +1351,12 @@ class NodeReplicated(_FusedTier):
             self._m_fused_fallback.inc()
             return False
         # tail == pos0: the GC-help loop never appends
-        timing = (self._fused_mode == "auto"
-                  and self._fused_choice is None)
+        timing = self._fused_calibrating(fkey)
         t0 = time.perf_counter()
         fenced = self._fenced
         extra = {"deferred": True} if pending is not None else {}
+        if eng.tier == "mesh_fused":
+            extra["devices"] = eng.devices
         with span("fused-round", rid=rid, n=n, pos0=pos0,
                   window=pad, **extra) as sp:
             self.log, self.states, resps = eng.round(
@@ -1242,7 +1370,7 @@ class NodeReplicated(_FusedTier):
                 sp.fence(self.log, self.states)
         if timing:
             self._note_fused_sample(
-                "pallas_fused", pad, time.perf_counter() - t0
+                "pallas_fused", pad, time.perf_counter() - t0, fkey
             )
         if self._wal is not None:
             # same order as the chain: journal once the ops ARE in the
@@ -1255,17 +1383,22 @@ class NodeReplicated(_FusedTier):
             self._wal.maybe_reclaim(floor)
         self._fused_rounds += 1
         self._m_engine_fused.inc()
+        if eng.tier == "mesh_fused":
+            # a mesh round by tier: counted next to the shmap/gspmd
+            # chain rounds (nr.exec.mesh.*)
+            self._m_mesh_fused_round.inc()
         if pending is not None:
             # split round: the launch is in flight; `_finish_round`
             # reads the responses back and delivers
             pending.fused_resps = resps
+            pending.tier = eng.tier
             return True
         for j, tid in enumerate(tids):
             self._contexts[(rid, tid)].enqueue_resps(
                 [int(resps_np[rid, j])]
             )
-        self.last_round_tier = "pallas_fused"
-        self._tier_by_rid[rid] = "pallas_fused"
+        self.last_round_tier = eng.tier
+        self._tier_by_rid[rid] = eng.tier
         self._pos_by_rid[rid] = pos0
         return True
 
@@ -1318,14 +1451,16 @@ class NodeReplicated(_FusedTier):
         opcodes, args, _ = encode_ops(
             ops, self.spec.arg_width, pad_to=pad
         )
-        timing = (self._fused_mode == "auto"
-                  and self._fused_choice is None)
+        fkey = self._fused_fence_key()  # once per round: O(R) scan
+        timing = self._fused_calibrating(fkey)
         defer = defer and not timing
         pending = _PendingRound(rid, list(tids), n, pos0, batch=batch)
         pending.pad = pad
+        pending.fkey = fkey
         if self._try_fused_round(ops, rid, tids, n, pos0, pad,
                                  opcodes, args,
-                                 pending if defer else None):
+                                 pending if defer else None,
+                                 fkey=fkey):
             if pending.fused_resps is None:
                 pending.done = True  # ran eagerly end-to-end
             return pending
@@ -1377,8 +1512,9 @@ class NodeReplicated(_FusedTier):
                 self._contexts[(rid, tid)].enqueue_resps(
                     [int(resps_np[rid, j])]
                 )
-            self.last_round_tier = "pallas_fused"
-            self._tier_by_rid[rid] = "pallas_fused"
+            tier = pending.tier or "pallas_fused"
+            self.last_round_tier = tier
+            self._tier_by_rid[rid] = tier
             self._pos_by_rid[rid] = pending.pos0
             return
         target = pending.target
@@ -1393,10 +1529,11 @@ class NodeReplicated(_FusedTier):
         self._pos_by_rid[rid] = pending.pos0
         if pending.t_chain is not None:
             # the replay loop's cursor readbacks serialize the chain,
-            # so the wall delta is an honest device-time sample
+            # so the wall delta is an honest device-time sample (keyed
+            # on the fence mask the round BEGAN under)
             self._note_fused_sample("chain", pending.pad,
                                     time.perf_counter()
-                                    - pending.t_chain)
+                                    - pending.t_chain, pending.fkey)
 
     @_locked
     def _append_and_replay(self, ops: list[tuple], rid: int,

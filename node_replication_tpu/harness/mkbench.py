@@ -75,7 +75,7 @@ MESH_CSV = "mesh_benchmarks.csv"
 _MESH_FIELDS = [
     "name", "devices", "replicas", "batch", "keys", "duration",
     "throughput_mdps", "scaling_x", "efficiency", "bit_identical",
-    "spread_pct",
+    "spread_pct", "tier", "launches_per_round",
 ]
 # One row per overload run (`bench.py --overload`), static baseline
 # and adaptive controller side by side: open-loop Poisson arrivals at
@@ -1029,8 +1029,29 @@ def mesh_rows(name: str, points: list[MeshPoint], batch: int,
             "efficiency": round(scaling / p.devices, 4),
             "bit_identical": int(p.bit_identical),
             "spread_pct": round(p.spread_pct, 2),
+            "tier": "step",  # the fused lock-step scaling curve
         })
     return rows
+
+
+def mesh_tier_rows(name: str, window: int,
+                   points: list["KernelPoint"]) -> list[dict]:
+    """MESH_CSV rows for the per-width exec-TIER column (`bench.py
+    --mesh`): one row per (devices, tier) from the combiner-round
+    sweep (`measure_kernel(devices=...)`) — mesh_fused vs the shmap
+    chain at each width, with the counter-derived launch count."""
+    return [{
+        "name": f"{name}/tier{p.devices}/{p.tier}",
+        "devices": p.devices,
+        "replicas": p.n_replicas,
+        "batch": window,
+        "keys": p.n_keys,
+        "duration": round(p.duration_s, 3),
+        "throughput_mdps": round(p.dispatches_per_sec / 1e6, 3),
+        "bit_identical": int(p.bit_identical),
+        "tier": p.tier,
+        "launches_per_round": p.launches_per_round,
+    } for p in points]
 
 
 def append_mesh_csv(out_dir: str, rows: list[dict]) -> None:
@@ -1040,9 +1061,10 @@ def append_mesh_csv(out_dir: str, rows: list[dict]) -> None:
 # --------------------------------------------------------------- kernel
 KERNEL_CSV = "kernel_benchmarks.csv"
 _KERNEL_FIELDS = [
-    "name", "tier", "replicas", "keys", "window", "capacity", "rounds",
-    "duration", "dispatches_per_sec", "launches_per_round", "p50_ms",
-    "p95_ms", "bit_identical", "interpret",
+    "name", "tier", "devices", "replicas", "keys", "window",
+    "capacity", "rounds", "duration", "dispatches_per_sec",
+    "launches_per_round", "p50_ms", "p95_ms", "bit_identical",
+    "interpret",
 ]
 
 
@@ -1050,8 +1072,13 @@ _KERNEL_FIELDS = [
 class KernelPoint:
     """One (config, tier) measurement of the combiner-round engines
     (`bench.py --kernel`): fused pallas round vs the append+exec chain
-    on the combined and scan engines, bit-identity verified BEFORE any
-    timing (a fast wrong kernel is worthless)."""
+    on the combined and scan engines — and, with `devices > 1`, the
+    MESH-FUSED shard_map round vs the shmap append+exec chain —
+    bit-identity verified BEFORE any timing (a fast wrong kernel is
+    worthless). `launches_per_round` is derived from the
+    `kernel.launches` counter delta over the timed rounds, never a
+    hardcoded constant, so the CSV cannot drift from what actually
+    ran."""
 
     tier: str
     n_replicas: int
@@ -1066,6 +1093,7 @@ class KernelPoint:
     p95_ms: float
     bit_identical: bool
     interpret: bool
+    devices: int = 1
 
 
 def _kernel_batches(n_keys: int, window: int, arg_width: int, seed: int,
@@ -1090,28 +1118,42 @@ def measure_kernel(
     n_replicas: int,
     window: int,
     duration_s: float = 1.0,
-    tiers: Sequence[str] = ("pallas_fused", "combined", "scan"),
+    tiers: Sequence[str] | None = None,
     interpret: bool | None = None,
     verify_rounds: int = 4,
     seed: int = 0,
+    devices: int = 1,
 ) -> list[KernelPoint]:
-    """Measure one (R, K, W) point across the combiner-round tiers.
+    """Measure one (R, K, W[, devices]) point across the
+    combiner-round tiers.
 
-    Chain tiers (`combined`/`scan`) run the round the wrapper's
-    `_append_and_replay` actually runs: an append program, a host
-    boundary, then one exec program over the appended window — 2
-    launches per round. The `pallas_fused` tier runs the
-    `FusedHashmapEngine` raw round with TRANSPOSED-RESIDENT state
-    (state stays in kernel layout across rounds — the flagship
-    configuration), usually 1 launch.
+    At `devices=1`: chain tiers (`combined`/`scan`) run the round the
+    wrapper's `_append_and_replay` actually runs — an append program,
+    a host boundary, then one exec program over the appended window —
+    and the `pallas_fused` tier runs the `FusedHashmapEngine` raw
+    round with TRANSPOSED-RESIDENT state (state stays in kernel layout
+    across rounds — the flagship configuration), usually 1 launch.
+
+    At `devices>1` the tiers are the MESH pair: `shmap` = the
+    replicated append program + `make_shmap_exec` round (the PR 9
+    chain, 2 programs per round), `mesh_fused` = `MeshFusedEngine`
+    (`parallel/collectives.py`) — one shard_map-wrapped launch per
+    device, state under `P('replica')`. The kernel_benchmarks.csv
+    claim this axis exists for: `launches_per_round` stays 1 as
+    devices scale.
 
     Before any timing, every tier replays `verify_rounds` identical
-    batches from identical init and must match the SCAN tier bit-
-    for-bit: model-layout states, every log cursor, the ring content,
-    and per-round responses. Per-round latency (p50/p95) is fenced —
-    each timed round ends on a real device fence (`utils/fence.py`),
-    so the per-batch latency floor is honest, not dispatch-rate
-    fiction.
+    batches from identical init and must match the 1-DEVICE SCAN tier
+    bit-for-bit: model-layout states, every log cursor, the ring
+    content, and per-round responses. Per-round latency (p50/p95) is
+    fenced — each timed round ends on a real device fence
+    (`utils/fence.py`), so the per-batch latency floor is honest, not
+    dispatch-rate fiction. `launches_per_round` is the
+    `kernel.launches` counter delta over the timed rounds divided by
+    the round count — every runner routes its launches through that
+    counter (the fused tiers via the engines' `note_round`
+    instrumentation hook, the chains by counting each program
+    dispatch), so the CSV reports what ran, not a constant.
     """
     import jax
     import jax.numpy as jnp
@@ -1125,10 +1167,16 @@ def measure_kernel(
     )
     from node_replication_tpu.core.replica import replicate_state
     from node_replication_tpu.models import make_hashmap
+    from node_replication_tpu.obs.metrics import get_registry
     from node_replication_tpu.utils.fence import fence
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if tiers is None:
+        tiers = (
+            ("mesh_fused", "shmap") if devices > 1
+            else ("pallas_fused", "combined", "scan")
+        )
     W = int(window)
     spec = LogSpec(
         capacity=max(4 * W, 512), n_replicas=n_replicas, arg_width=3,
@@ -1137,21 +1185,37 @@ def measure_kernel(
     d = make_hashmap(n_keys)
     batches = _kernel_batches(n_keys, W, spec.arg_width, seed)
     S = len(batches)
+    mesh = None
+    if devices > 1:
+        from node_replication_tpu.parallel.mesh import replica_mesh
 
-    def make_chain(engine: str):
+        if n_replicas % devices:
+            raise ValueError(
+                f"R={n_replicas} not divisible by devices={devices}"
+            )
+        if devices > len(jax.devices()):
+            raise ValueError(
+                f"devices={devices} requested, "
+                f"{len(jax.devices())} visible"
+            )
+        mesh = replica_mesh(devices)
+    reg = get_registry()
+    launch_c = reg.counter("kernel.launches")
+
+    def fresh_fleet():
+        return log_init(spec), replicate_state(d.init_state(),
+                                               n_replicas)
+
+    def chain_runner(exec_jit, init_fn):
+        # ONE chain shape for the single-device and shmap tiers:
+        # append program, host boundary, exec program — each counted
+        # at its dispatch site, so a change to the round protocol or
+        # the launch accounting cannot diverge between them
         append_jit = jax.jit(
             functools.partial(log_append, spec), donate_argnums=(0,)
         )
-        exec_fn = log_exec_all if engine == "scan" else log_catchup_all
-
-        def exec_round(log, states):
-            return exec_fn(spec, d, log, states, window=W)
-
-        exec_jit = jax.jit(exec_round, donate_argnums=(0, 1))
 
         class Chain:
-            launches = 2
-
             def __init__(self):
                 self.reset()
 
@@ -1159,17 +1223,15 @@ def measure_kernel(
                 # fresh fleet, SAME compiled programs: the timing
                 # phase reuses the verify phase's jits instead of
                 # paying every compile twice per point
-                self.log = log_init(spec)
-                self.states = replicate_state(d.init_state(),
-                                              n_replicas)
+                self.log, self.states = init_fn()
 
             def round(self, opc, args):
-                # the wrapper's chain shape: append program, host
-                # boundary, exec program
                 self.log = append_jit(self.log, opc, args, W)
+                launch_c.inc()
                 self.log, self.states, resps = exec_jit(
                     self.log, self.states
                 )
+                launch_c.inc()
                 return resps
 
             def model_states(self):
@@ -1179,6 +1241,27 @@ def measure_kernel(
                 fence(self.log, self.states)
 
         return Chain()
+
+    def make_chain(engine: str):
+        exec_fn = log_exec_all if engine == "scan" else log_catchup_all
+
+        def exec_round(log, states):
+            return exec_fn(spec, d, log, states, window=W)
+
+        return chain_runner(
+            jax.jit(exec_round, donate_argnums=(0, 1)), fresh_fleet
+        )
+
+    def make_shmap():
+        from node_replication_tpu.parallel.collectives import (
+            make_shmap_exec,
+        )
+        from node_replication_tpu.parallel.mesh import place
+
+        return chain_runner(
+            make_shmap_exec(d, spec, mesh, W),
+            lambda: place(*fresh_fleet(), mesh),
+        )
 
     def make_fused():
         eng = d.fused_factory(spec, interpret=interpret)
@@ -1193,8 +1276,6 @@ def measure_kernel(
         kp = eng.kp
 
         class Fused:
-            launches = eng.launches(W)
-
             def __init__(self):
                 self.reset()
 
@@ -1208,9 +1289,15 @@ def measure_kernel(
                 )
 
             def round(self, opc, args):
+                t0 = time.perf_counter()
                 self.log, self.vals, self.pres, resps = run(
                     self.log, self.vals, self.pres, opc, args, W
                 )
+                # the bench embeds raw_round in its own loop, so the
+                # engine's round() wrapper never runs — report through
+                # the same instrumentation hook (kernel.launches et
+                # al.; one contract, never two)
+                eng.note_round(W, W, time.perf_counter() - t0)
                 return resps.T  # [R, W], the chain layout
 
             def model_states(self):
@@ -1224,78 +1311,138 @@ def measure_kernel(
 
         return Fused()
 
-    def build(tier: str):
-        return make_fused() if tier == "pallas_fused" else \
-            make_chain(tier)
-
-    # ---- bit-identity BEFORE timing (scan is the reference) --------
-    ref = make_chain("scan")
-    ref_resps = []
-    for i in range(verify_rounds):
-        ref_resps.append(np.asarray(ref.round(*batches[i % S])))
-    ref.fence_all()
-    ref_states = [np.asarray(a)
-                  for a in jax.tree.leaves(ref.model_states())]
-    ref_log = jax.tree.map(np.asarray, ref.log)
-
-    points: list[KernelPoint] = []
-    for tier in tiers:
-        runner = build(tier)
-        ok = True
-        for i in range(verify_rounds):
-            got = np.asarray(runner.round(*batches[i % S]))
-            if not np.array_equal(got, ref_resps[i]):
-                ok = False
-        runner.fence_all()
-        got_states = [np.asarray(a)
-                      for a in jax.tree.leaves(runner.model_states())]
-        ok = ok and all(
-            np.array_equal(a, b)
-            for a, b in zip(ref_states, got_states)
-        ) and all(
-            np.array_equal(np.asarray(a), b)
-            for a, b in zip(jax.tree.leaves(runner.log),
-                            jax.tree.leaves(ref_log))
+    def make_mesh_fused():
+        from node_replication_tpu.parallel.collectives import (
+            MeshFusedEngine,
         )
-        # ---- fenced per-round timing on a fresh fleet --------------
-        # (same runner: the verify rounds already compiled + warmed
-        # every program; reset() only re-inits the fleet arrays)
-        runner.reset()
-        runner.round(*batches[0])  # warm from the fresh init
-        runner.fence_all()
-        lat: list[float] = []
-        total = 0.0
-        i = 0
-        while total < duration_s or len(lat) < 3:
-            t0 = time.perf_counter()
-            runner.round(*batches[i % S])
+        from node_replication_tpu.parallel.mesh import place
+
+        eng = MeshFusedEngine(d, spec, mesh, interpret=interpret)
+        if not eng.supports(W):
+            raise ValueError(
+                f"mesh-fused engine rejects window {W} at capacity "
+                f"{spec.capacity} over {devices} devices"
+            )
+
+        class MeshFused:
+            def __init__(self):
+                self.reset()
+
+            def reset(self):
+                self.log, self.states = place(
+                    log_init(spec),
+                    replicate_state(d.init_state(), n_replicas),
+                    mesh,
+                )
+
+            def round(self, opc, args):
+                # the host entry: cached shard_map program + the
+                # note_round instrumentation (kernel.launches counts
+                # the per-device launches)
+                self.log, self.states, resps = eng.round(
+                    self.log, self.states, opc, args, W
+                )
+                return resps
+
+            def model_states(self):
+                return self.states
+
+            def fence_all(self):
+                fence(self.log, self.states)
+
+        return MeshFused()
+
+    def build(tier: str):
+        if tier == "pallas_fused":
+            return make_fused()
+        if tier == "mesh_fused":
+            return make_mesh_fused()
+        if tier == "shmap":
+            return make_shmap()
+        return make_chain(tier)
+
+    was_enabled = reg.enabled
+    reg.enable()  # launches_per_round is a counter delta
+    try:
+        # ---- bit-identity BEFORE timing (the 1-device scan chain is
+        # the reference at EVERY devices count) --------------------
+        ref = make_chain("scan")
+        ref_resps = []
+        for i in range(verify_rounds):
+            ref_resps.append(np.asarray(ref.round(*batches[i % S])))
+        ref.fence_all()
+        ref_states = [np.asarray(a)
+                      for a in jax.tree.leaves(ref.model_states())]
+        ref_log = jax.tree.map(np.asarray, ref.log)
+
+        points: list[KernelPoint] = []
+        for tier in tiers:
+            runner = build(tier)
+            ok = True
+            for i in range(verify_rounds):
+                got = np.asarray(runner.round(*batches[i % S]))
+                if not np.array_equal(got, ref_resps[i]):
+                    ok = False
             runner.fence_all()
-            dt = time.perf_counter() - t0
-            lat.append(dt)
-            total += dt
-            i += 1
-            if len(lat) >= 10_000:  # interpret-mode safety valve
-                break
-        lat.sort()
-        rounds = len(lat)
-        dps = n_replicas * W * rounds / total if total else 0.0
-        points.append(KernelPoint(
-            tier=tier, n_replicas=n_replicas, n_keys=n_keys, window=W,
-            capacity=spec.capacity, rounds=rounds, duration_s=total,
-            dispatches_per_sec=dps,
-            launches_per_round=runner.launches,
-            p50_ms=1e3 * lat[rounds // 2],
-            p95_ms=1e3 * lat[min(rounds - 1, int(rounds * 0.95))],
-            bit_identical=ok, interpret=interpret,
-        ))
+            got_states = [
+                np.asarray(a)
+                for a in jax.tree.leaves(runner.model_states())
+            ]
+            ok = ok and all(
+                np.array_equal(a, b)
+                for a, b in zip(ref_states, got_states)
+            ) and all(
+                np.array_equal(np.asarray(a), b)
+                for a, b in zip(jax.tree.leaves(runner.log),
+                                jax.tree.leaves(ref_log))
+            )
+            # ---- fenced per-round timing on a fresh fleet ----------
+            # (same runner: the verify rounds already compiled +
+            # warmed every program; reset() only re-inits the fleet)
+            runner.reset()
+            runner.round(*batches[0])  # warm from the fresh init
+            runner.fence_all()
+            lat: list[float] = []
+            total = 0.0
+            i = 0
+            mark = launch_c.value
+            while total < duration_s or len(lat) < 3:
+                t0 = time.perf_counter()
+                runner.round(*batches[i % S])
+                runner.fence_all()
+                dt = time.perf_counter() - t0
+                lat.append(dt)
+                total += dt
+                i += 1
+                if len(lat) >= 10_000:  # interpret-mode safety valve
+                    break
+            lat.sort()
+            rounds = len(lat)
+            dps = n_replicas * W * rounds / total if total else 0.0
+            points.append(KernelPoint(
+                tier=tier, n_replicas=n_replicas, n_keys=n_keys,
+                window=W, capacity=spec.capacity, rounds=rounds,
+                duration_s=total, dispatches_per_sec=dps,
+                launches_per_round=(
+                    (launch_c.value - mark) // rounds
+                ),
+                p50_ms=1e3 * lat[rounds // 2],
+                p95_ms=1e3 * lat[min(rounds - 1, int(rounds * 0.95))],
+                bit_identical=ok, interpret=interpret,
+                devices=devices,
+            ))
+    finally:
+        reg.enabled = was_enabled
     return points
 
 
 def kernel_rows(name: str, points: list[KernelPoint]) -> list[dict]:
-    """KERNEL_CSV rows for one (R, K, W) point's tier sweep."""
+    """KERNEL_CSV rows for one (R, K, W[, devices]) point's tier
+    sweep."""
     return [{
         "name": f"{name}/{p.tier}",
         "tier": p.tier,
+        "devices": p.devices,
         "replicas": p.n_replicas,
         "keys": p.n_keys,
         "window": p.window,

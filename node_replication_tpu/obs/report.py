@@ -743,6 +743,11 @@ def analyze(events: list[dict]) -> dict:
             "calibrations": [
                 {"winner": e.get("winner", "?"),
                  "window": int(e.get("window", 0)),
+                 # mesh-aware + fence-keyed verdicts (ISSUE 15):
+                 # absent on pre-mesh traces, defaults keep old
+                 # artifacts renderable
+                 "devices": int(e.get("devices", 1) or 1),
+                 "fenced": list(e.get("fenced", []) or []),
                  "fused_s": float(e.get("fused_s", 0.0)),
                  "chain_s": float(e.get("chain_s", 0.0))}
                 for e in cals

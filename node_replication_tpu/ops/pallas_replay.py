@@ -485,8 +485,11 @@ class FusedHashmapEngine(FusedEngineHost):
     `P('replica')`-sharded slicing of the PR 9 mesh tier: a per-shard
     invocation of the chunk calls is the shard-local program
     (tests/test_pallas_fused.py pins chunk-slice composability). The
-    wrapper currently takes the fused tier only un-meshed; the shmap
-    wiring composes over these same chunks.
+    MESH-FUSED exec tier (`parallel/collectives.py:MeshFusedEngine`)
+    is exactly that composition routed into the wrapper: this engine
+    built at the shard's slice of the replica axis, wrapped in
+    shard_map with the cursor lattice joined over ICI — one launch
+    per device per combiner round at every mesh width.
     """
 
     supports_fenced = True

@@ -160,11 +160,21 @@ class FusedEngineHost:
     a `supports_fenced` class flag, and set `self.interpret`.
 
     `note_round` is public so callers that embed `round_fn` in their
-    own program (the CNR per-log wrapper) report the same metrics as
-    callers of `round()` — one instrumentation contract, never two.
+    own program (the CNR per-log wrapper, the kernel bench) report the
+    same metrics as callers of `round()` — one instrumentation
+    contract, never two.
+
+    `tier`/`devices` identify the engine in that contract: the plain
+    single-device engines are `pallas_fused` on 1 device; the
+    shard_map-wrapped mesh composition
+    (`parallel/collectives.py:MeshFusedEngine`) overrides both, so its
+    rounds count under `log.engine.mesh_fused` and its `kernel-launch`
+    events carry the mesh width.
     """
 
     supports_fenced = False
+    tier = "pallas_fused"
+    devices = 1
 
     def _init_host(self) -> None:
         from node_replication_tpu.obs.metrics import (
@@ -185,13 +195,21 @@ class FusedEngineHost:
         """Count one fused round: tier counter, kernel.* metrics,
         kernel-launch event. Duration is enqueue-side (the tunneled
         platform returns at dispatch); fenced timing is the caller's
-        span contract."""
+        span contract. `kernel.launches` advances by
+        `launches(window)` — the engine's claim, derived from the same
+        built chunk structure the round loop iterates (a compiled
+        round's dispatches are invisible to the host, so this is the
+        best available truth; the bench's chain runners, whose
+        dispatches ARE host calls, count at the call sites instead)."""
         from node_replication_tpu.core import log as _corelog
         from node_replication_tpu.utils.trace import get_tracer
 
         n_launch = self.launches(window)
         # nrlint: disable=obs-in-traced — host side of the jit boundary
-        _corelog._m_engine_pallas_fused.inc()
+        if self.tier == "mesh_fused":
+            _corelog._m_engine_mesh_fused.inc()
+        else:
+            _corelog._m_engine_pallas_fused.inc()
         self._m_launches.inc(n_launch)
         self._m_ops.inc(int(count))
         self._m_window.observe(window)
@@ -199,8 +217,9 @@ class FusedEngineHost:
         tracer = get_tracer()
         if tracer.enabled:
             tracer.emit(
-                "kernel-launch", tier="pallas_fused", window=window,
+                "kernel-launch", tier=self.tier, window=window,
                 count=int(count), launches=n_launch,
+                devices=self.devices,
                 duration_s=duration_s, fenced=fenced,
             )
 

@@ -938,9 +938,12 @@ class FusedVspaceEngine(FusedEngineHost):
     enforces. No fenced variant: the span kernel's group layout lets
     replica 0 speak for its group, which a frozen corrupt lane would
     poison — fenced fleets fall back to the chain
-    (`supports_fenced=False`). The radix model keeps the replay-only
-    kernels (its level tables ride registers; a fused variant is a
-    follow-up)."""
+    (`supports_fenced=False`), meshed or not: the MESH-FUSED
+    composition (`parallel/collectives.py:MeshFusedEngine`) builds
+    this engine per replica shard through the same factory, and its
+    canonical responses broadcast per shard exactly as they do
+    fleet-wide. The radix model keeps the replay-only kernels (its
+    level tables ride registers; a fused variant is a follow-up)."""
 
     supports_fenced = False
 
@@ -987,10 +990,11 @@ class FusedVspaceEngine(FusedEngineHost):
         )
 
     def launches(self, window: int) -> int:
-        from node_replication_tpu.ops.pallas_chunk import chunk_size
-
-        return -(-self.spec.n_replicas
-                 // chunk_size(self.spec.n_replicas, self._group))
+        # derived from the BUILT chunk structure (the same chunk_r the
+        # round loop iterates), like the hashmap engine — not a
+        # recomputation that could drift from what actually dispatches
+        _, chunk_r = self._built(window)
+        return -(-self.spec.n_replicas // chunk_r)
 
     def _built(self, window: int):
         calls = self._calls.get(window)

@@ -1,4 +1,5 @@
 from node_replication_tpu.parallel.collectives import (
+    MeshFusedEngine,
     make_ring_exec,
     make_shmap_exec,
     make_shmap_step,
@@ -13,6 +14,7 @@ from node_replication_tpu.parallel.mesh import (
 from node_replication_tpu.parallel.topology import MachineTopology
 
 __all__ = [
+    "MeshFusedEngine",
     "ReplicaStrategy",
     "make_mesh",
     "make_ring_exec",

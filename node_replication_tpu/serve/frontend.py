@@ -1615,11 +1615,15 @@ class ServeFrontend:
         tracer = get_tracer()
         if tracer.enabled:
             # which combiner-round engine served this batch
-            # (pallas_fused / combined / scan — obs/report's Kernels
-            # section consumes). Per-rid lookup: this worker is the
-            # only round-driver for its replica, so the stamp cannot
-            # be overwritten by a concurrent worker's round the way a
-            # wrapper-wide field would be.
+            # (pallas_fused / mesh_fused / combined / scan —
+            # obs/report's Kernels section consumes; meshed fleets
+            # route eligible rounds through the one-launch mesh-fused
+            # tier, and the pipelined worker's defer=True issues that
+            # meshed launch at _begin_round with readback at
+            # _finish_round, so the overlap composes). Per-rid lookup:
+            # this worker is the only round-driver for its replica, so
+            # the stamp cannot be overwritten by a concurrent worker's
+            # round the way a wrapper-wide field would be.
             tier_of = getattr(self._nr, "round_tier", None)
             # per-record trace join key (`obs/` fleet tracing): the
             # log position this batch appended at, read per-rid for

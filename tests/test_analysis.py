@@ -1256,6 +1256,94 @@ class TestAliasedPallasPlanes:
         """)
         assert not firing(diags, "aliased-pallas-planes")
 
+    def test_shard_map_wrapped_blocked_aliasing_fires(self, tmp_path):
+        # the mesh-fused era variant of the race: the pallas_call
+        # lives in a nested shard-local function (wrapped in
+        # shard_map) while grid/in_specs/input_output_aliases are
+        # bound in the enclosing builder — closure-level resolution
+        # must still see the blocked aliased plane
+        diags = self._lint_in_ops(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+            from node_replication_tpu.utils.compat import shard_map
+
+            def build(kernel, kp, tile, R, mesh, shape, P):
+                grid = (R // tile,)
+                specs = [pl.BlockSpec((kp, tile), lambda i: (0, i))]
+                al = {0: 0}
+
+                def local(states_l):
+                    return pl.pallas_call(
+                        kernel,
+                        grid=grid,
+                        in_specs=specs,
+                        out_specs=specs,
+                        out_shape=shape,
+                        input_output_aliases=al,
+                    )(states_l)
+
+                return shard_map(local, mesh=mesh, in_specs=P,
+                                 out_specs=P)
+        """)
+        assert len(firing(diags, "aliased-pallas-planes")) == 1
+
+    def test_rebound_grid_resolves_to_last_assignment(self, tmp_path):
+        # within a scope the LAST assignment wins (closure resolution
+        # must not invert _local_aliases's order): a grid rebound from
+        # (1,) to multi-step before the call is a real race and must
+        # still fire
+        diags = self._lint_in_ops(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def build(kernel, kp, tile, R, shape):
+                grid = (1,)
+                grid = (R // tile,)
+                return pl.pallas_call(
+                    kernel,
+                    grid=grid,
+                    in_specs=[pl.BlockSpec((kp, tile), lambda i: (0, i))],
+                    out_specs=[pl.BlockSpec((kp, tile), lambda i: (0, i))],
+                    out_shape=shape,
+                    input_output_aliases={0: 0},
+                )
+        """)
+        assert len(firing(diags, "aliased-pallas-planes")) == 1
+
+    def test_shard_map_wrapped_unblocked_dma_clean(self, tmp_path):
+        # the sanctioned mesh-fused shape: the aliased refs are
+        # UN-BLOCKED ANY planes moved by explicit DMA (the replicated
+        # ring copies), outside the grid pipeline — clean even when
+        # the call is built inside the shard-local closure
+        diags = self._lint_in_ops(tmp_path, """
+            import jax
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+            from node_replication_tpu.utils.compat import shard_map
+
+            def build(kernel, kp, tile, R, mesh, shape, P):
+                grid = (R // tile,)
+                specs = [
+                    pl.BlockSpec(memory_space=pltpu.ANY),
+                    pl.BlockSpec((kp, tile), lambda i: (0, i)),
+                ]
+                al = {0: 0}
+
+                def local(ring, states_l):
+                    return pl.pallas_call(
+                        kernel,
+                        grid=grid,
+                        in_specs=specs,
+                        out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                        out_shape=shape,
+                        input_output_aliases=al,
+                    )(ring, states_l)
+
+                return shard_map(local, mesh=mesh, in_specs=P,
+                                 out_specs=P)
+        """)
+        assert not firing(diags, "aliased-pallas-planes")
+
     def test_outside_ops_and_unaliased_clean(self, tmp_path):
         # path scope: kernels live in ops/; an aliased call elsewhere
         # (scratch experiments, tests) is out of scope — and a deep

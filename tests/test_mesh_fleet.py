@@ -1,4 +1,5 @@
-"""Mesh-sharded fleet differential suite (ISSUE 10).
+"""Mesh-sharded fleet differential suite (ISSUE 10 + the ISSUE 15
+mesh-fused tier).
 
 The acceptance contract of the mesh work: placement changes SPEED,
 never results. Every test drives the same op sequence through an
@@ -8,7 +9,13 @@ conftest.py) and requires bit-identical responses, states, and cursor
 lattices — scan AND union engines, both collective tiers (shmap /
 gspmd), hashmap AND seqreg models, with a fenced-replica case pinning
 the cross-device GC-head mask and a ring-tier case pinning the
-collective catch-up path. This file is the CI `mesh-smoke` job.
+collective catch-up path. `TestMeshFused` extends the contract to the
+MESH-FUSED exec tier (`parallel/collectives.py:MeshFusedEngine`): one
+shard_map-wrapped Pallas launch per combiner round, pinned
+bit-identical to the un-meshed scan wrapper across ring wraps, a
+fence/repair cycle with the corpse on a non-zero shard, mesh-aware
+calibration resets, and depth-1 pipelined serve. This file is the CI
+`mesh-smoke` job (the mesh-fused half also rides `kernel-smoke`).
 """
 
 import jax
@@ -279,6 +286,252 @@ class TestNodeReplicatedMesh:
         assert st["mesh"]["devices"] == 8
         assert sum(st["mesh"]["replicas_per_device"].values()) == 8
         assert len(st["mesh"]["device_of_rid"]) == 8
+
+
+def _mixed_ops(rng, n, n_keys):
+    ops = []
+    for _ in range(n):
+        if rng.rand() < 0.7:
+            ops.append((HM_PUT, int(rng.randint(n_keys)),
+                        int(rng.randint(1000))))
+        else:
+            ops.append((2, int(rng.randint(n_keys))))
+    return ops
+
+
+class TestMeshFused:
+    """The mesh-fused exec tier differential contract (interpret mode
+    on forced host devices; the shard_map program runs eagerly — same
+    convention as every other interpret pallas test)."""
+
+    def test_forced_30_rounds_two_wraps_fence_repair(self):
+        # 30 mesh-fused combiner rounds vs the un-meshed scan chain:
+        # ~18-op batches against a 256-slot ring wrap it twice, a
+        # replica is fenced mid-run with the corpse on a NON-ZERO
+        # shard (rid 3 = shard 1 of the 2-wide mesh), repaired, and
+        # every round's responses + the final states/cursor lattice
+        # must be bit-identical — the tier changes launch count, never
+        # results
+        mesh = replica_mesh(2)
+        K, R = 31, 4
+        nr_m = NodeReplicated(make_hashmap(K), n_replicas=R,
+                              log_entries=256, gc_slack=32,
+                              exec_window=32, engine="pallas",
+                              mesh=mesh)
+        nr_s = NodeReplicated(make_hashmap(K), n_replicas=R,
+                              log_entries=256, gc_slack=32,
+                              exec_window=32, engine="scan")
+        reg = get_registry()
+        reg.enable()
+        before = reg.counter("log.engine.mesh_fused").value
+        mesh_before = reg.counter("nr.exec.mesh.mesh_fused").value
+        rng = np.random.RandomState(7)
+        for rnd in range(30):
+            if rnd == 12:
+                for nr in (nr_m, nr_s):
+                    nr.fence_replica(3)
+                assert 3 in nr_m.fenced_rids
+            if rnd == 16:
+                for nr in (nr_m, nr_s):
+                    nr.clone_replica_from(3, donor=0)
+                    nr.unfence_replica(3)
+            ops = _mixed_ops(rng, int(rng.randint(18, 26)), K)
+            assert nr_m.execute_mut_batch(ops, rid=0) == \
+                nr_s.execute_mut_batch(ops, rid=0), rnd
+        assert int(nr_m.log.tail) > 2 * 256  # two genuine ring wraps
+        nr_m.sync(); nr_s.sync()
+        _assert_fleets_equal(nr_s, nr_m)
+        assert nr_m.replicas_equal()
+        st = nr_m.stats()
+        assert st["fused_tier"] == "forced"
+        assert st["fused_rounds"] == 30  # every round one meshed launch
+        assert st["exec_rounds"] == 0
+        assert nr_m.last_round_tier == "mesh_fused"
+        assert nr_m.round_tier(0) == "mesh_fused"
+        assert reg.counter("log.engine.mesh_fused").value \
+            - before == 30
+        assert reg.counter("nr.exec.mesh.mesh_fused").value \
+            - mesh_before == 30
+
+    def test_shmap_program_matches_sliced_composition(self):
+        # the compilation-policy pin: interpret rounds run the
+        # shard-sliced composition, TPU jits the shard_map program —
+        # the two must be bit-identical, unfenced AND fenced (the
+        # _FAR-composed GC join), so the program the TPU compiles is
+        # covered by this CPU suite. One eager shard_map call per
+        # variant (seconds each on this jax — why the bulk suite uses
+        # the sliced path).
+        from node_replication_tpu.core.log import LogSpec, log_init
+        from node_replication_tpu.core.replica import replicate_state
+        from node_replication_tpu.ops.encoding import encode_ops
+        from node_replication_tpu.parallel import MeshFusedEngine
+
+        K, R = 13, 4
+        spec = LogSpec(capacity=256, n_replicas=R, arg_width=3,
+                       gc_slack=32)
+        d = make_hashmap(K)
+        eng = MeshFusedEngine(d, spec, replica_mesh(2))
+        rng = np.random.RandomState(3)
+        ops = [(HM_PUT, int(rng.randint(K)), int(rng.randint(100)))
+               for _ in range(7)]
+        opc, args, n = encode_ops(ops, 3, pad_to=8)
+        for fenced_vec in (None, np.array([False, False, True,
+                                           False])):
+            is_f = fenced_vec is not None
+            log = log_init(spec)
+            states = replicate_state(d.init_state(), R)
+            sliced = eng._sliced_round(8, is_f)
+            shmap = eng._shmap_round(8, is_f)
+            extra = (
+                (jnp.asarray(fenced_vec, bool),) if is_f else ()
+            )
+            a = sliced(log, states, opc, args, n, *extra)
+            b = shmap(log, states, opc, args, n, *extra)
+            for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                np.testing.assert_array_equal(
+                    np.asarray(la), np.asarray(lb),
+                    err_msg=f"fenced={is_f}",
+                )
+
+    def test_fenced_head_gc_corpse_on_other_shard(self):
+        # the composed _FAR mask: with the corpse fenced on shard 1,
+        # mesh-fused rounds must keep advancing head past its frozen
+        # cursor (the pmin lattice join excludes it), exactly like the
+        # un-meshed fleet
+        mesh = replica_mesh(2)
+        nr = NodeReplicated(make_hashmap(16), n_replicas=4,
+                            log_entries=256, gc_slack=32,
+                            engine="pallas", mesh=mesh)
+        nr.execute_mut_batch([(HM_PUT, 1, 1), (HM_PUT, 2, 2)], rid=0)
+        nr.fence_replica(2)  # shard 1 hosts rids 2, 3
+        frozen = int(np.asarray(nr.log.ltails)[2])
+        for i in range(3):
+            nr.execute_mut_batch([(HM_PUT, i, i * 3)], rid=0)
+        assert nr.stats()["fused_rounds"] == 4
+        assert int(np.asarray(nr.log.ltails)[2]) == frozen
+        assert int(nr.log.head) > frozen  # GC not stalled by the corpse
+
+    def test_grow_resets_calibration_at_devices_key(self, monkeypatch):
+        # mesh-aware winner selection: the verdict is measured at the
+        # live (R, capacity, devices) point — the fused-calibration
+        # event carries devices=, and growth recalibrates
+        monkeypatch.setenv("NR_TPU_FUSED_CAL", "1")
+        from node_replication_tpu.utils.trace import get_tracer
+
+        mesh = replica_mesh(4)
+        t = get_tracer()
+        t.enable(None)
+        try:
+            nr = NodeReplicated(make_hashmap(17), n_replicas=8,
+                                log_entries=512, gc_slack=64,
+                                engine="auto", mesh=mesh)
+            assert nr.stats()["fused_tier"] == "calibrating"
+            for i in range(8):
+                nr.execute_mut_batch(
+                    [(HM_PUT, i % 17, i), (HM_PUT, (i + 5) % 17, i)],
+                    rid=0,
+                )
+            st = nr.stats()
+            assert st["fused_tier"] in ("auto:mesh_fused",
+                                        "auto:chain"), st
+            cal = [e for e in t.events()
+                   if e["event"] == "fused-calibration"]
+            assert cal and cal[-1]["devices"] == 4
+            assert cal[-1]["tier"] == "mesh_fused"
+            assert cal[-1]["winner"] in ("mesh_fused", "chain")
+            nr.grow_fleet(4)
+            assert nr.stats()["fused_tier"] == "calibrating"
+        finally:
+            t.disable()
+
+    def test_vspace_mesh_fused_and_fenced_fallback(self):
+        # the second fused model rides the same factory composition:
+        # flat-vspace mesh-fused rounds are bit-identical to the
+        # un-meshed scan chain, and a fenced meshed fleet falls back
+        # (no fenced kernel variant) with identical results
+        from node_replication_tpu.models.vspace import make_vspace
+
+        mesh = replica_mesh(2)
+        P_pages = 512
+        mk = lambda **kw: NodeReplicated(
+            make_vspace(P_pages, max_span=8), n_replicas=4,
+            log_entries=512, gc_slack=64, **kw,
+        )
+        nr_m = mk(engine="pallas", mesh=mesh)
+        nr_s = mk(engine="scan")
+        rng = np.random.RandomState(11)
+        ops = []
+        for _ in range(12):
+            if rng.rand() < 0.7:
+                ops.append((1, int(rng.randint(P_pages)),
+                            int(rng.randint(1, 1000)),
+                            int(rng.randint(0, 8))))
+            else:
+                ops.append((2, int(rng.randint(P_pages)),
+                            int(rng.randint(0, 8))))
+        assert nr_m.execute_mut_batch(ops, rid=0) == \
+            nr_s.execute_mut_batch(ops, rid=0)
+        assert nr_m.last_round_tier == "mesh_fused"
+        reg = get_registry()
+        reg.enable()
+        fb = reg.counter("nr.exec.engine.fused_fallback")
+        before = fb.value
+        for nr in (nr_m, nr_s):
+            nr.fence_replica(3)
+        ops2 = [(1, 9, 99, 4)]
+        assert nr_m.execute_mut_batch(ops2, rid=0) == \
+            nr_s.execute_mut_batch(ops2, rid=0)
+        assert fb.value > before
+        assert nr_m.last_round_tier == nr_m.engine  # chain served it
+        nr_m.sync(); nr_s.sync()
+        for a, b in zip(jax.tree.leaves(nr_m.states),
+                        jax.tree.leaves(nr_s.states)):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+
+    def test_pipelined_serve_depth1_meshed(self):
+        # PR 14's overlap on a meshed fleet: defer=True issues the
+        # meshed launch at _begin_round (assembly stage) and reads
+        # back at _finish_round (completion stage) — serve-batch
+        # events must carry the mesh_fused tier and kernel-launch
+        # events the mesh width, with responses exact
+        from node_replication_tpu.serve import ServeConfig, ServeFrontend
+        from node_replication_tpu.utils.trace import get_tracer
+
+        mesh = replica_mesh(2)
+        nr = NodeReplicated(make_seqreg(8), n_replicas=2,
+                            log_entries=512, gc_slack=64,
+                            engine="scan", mesh=mesh)
+        # seqreg has no fused factory; the hashmap twin drives the
+        # fused tier — use hashmap for the fused serve and seqreg
+        # only as the no-factory sanity check
+        assert nr.stats()["fused_tier"] == "off"
+        nr_f = NodeReplicated(make_hashmap(32), n_replicas=2,
+                              log_entries=512, gc_slack=64,
+                              engine="pallas", mesh=mesh)
+        t = get_tracer()
+        t.enable(None)
+        try:
+            with ServeFrontend(
+                nr_f,
+                ServeConfig(queue_depth=32, batch_max_ops=8,
+                            batch_linger_s=0.002, pipeline_depth=1),
+            ) as fe:
+                for i in range(24):
+                    assert fe.call((HM_PUT, i % 32, i),
+                                   rid=fe.rids[i % 2]) == 0
+                assert fe.read((HM_GET, 5), rid=fe.rids[0]) >= 0
+            events = t.events()
+        finally:
+            t.disable()
+        batches = [e for e in events if e["event"] == "serve-batch"]
+        assert batches
+        assert all(e.get("engine") == "mesh_fused" for e in batches)
+        launches = [e for e in events if e["event"] == "kernel-launch"]
+        assert launches
+        assert all(e["tier"] == "mesh_fused" and e["devices"] == 2
+                   for e in launches)
+        assert nr_f.stats()["fused_rounds"] > 0
 
 
 class TestCnrMesh:

@@ -349,18 +349,88 @@ class TestAutoWinnerSelection:
             assert nr.stats()["exec_rounds"] > mark_exec
 
     def test_samples_are_per_window(self, monkeypatch):
-        # chain/fused timings only compare at the SAME padded window:
-        # a different batch size must not satisfy another window's
+        # chain/fused timings only compare at the SAME padded window
+        # (and the same fence mask — the key's second half): a
+        # different batch size must not satisfy another window's
         # calibration quota
         monkeypatch.setenv("NR_TPU_FUSED_CAL", "1")
         nr = NodeReplicated(make_hashmap(17), n_replicas=2,
                             log_entries=512, gc_slack=64, engine="auto")
         nr.execute_mut_batch([(1, 1, 1)], rid=0)          # pad 1
         nr.execute_mut_batch([(1, 1, 1), (1, 2, 2)], rid=0)  # pad 2
-        assert 1 in nr._fused_samples["chain"]
-        assert 2 in nr._fused_samples["chain"]
-        assert len(nr._fused_samples["chain"][1]) == 1
+        assert (1, ()) in nr._fused_samples["chain"]
+        assert (2, ()) in nr._fused_samples["chain"]
+        assert len(nr._fused_samples["chain"][(1, ())]) == 1
         assert nr.stats()["fused_tier"] == "calibrating"
+
+    def test_verdict_rekeys_on_fence_mask(self, monkeypatch):
+        # satellite regression (ISSUE 15): a verdict committed from
+        # UNFENCED rounds must not route fenced rounds through a tier
+        # whose fenced variant was never timed — samples and verdicts
+        # key on the fence mask, so a quarantine mid-serve
+        # recalibrates (second fused-calibration event, fenced key),
+        # and unfencing restores the original measured verdict
+        monkeypatch.setenv("NR_TPU_FUSED_CAL", "1")
+        from node_replication_tpu.utils.trace import get_tracer
+
+        t = get_tracer()
+        t.enable(None)
+        try:
+            nr = NodeReplicated(make_hashmap(17), n_replicas=4,
+                                log_entries=512, gc_slack=64,
+                                engine="auto")
+            tok = nr.register(0)
+            for i in range(8):
+                nr.execute_mut((1, i % 17, i), tok)
+            st = nr.stats()
+            assert st["fused_tier"] in ("auto:pallas_fused",
+                                        "auto:chain")
+            cal = [e for e in t.events()
+                   if e["event"] == "fused-calibration"]
+            assert len(cal) == 1 and cal[0]["fenced"] == []
+            nr.fence_replica(2)
+            # the unfenced verdict does NOT carry over the mask change
+            assert nr.stats()["fused_tier"] == "calibrating"
+            for i in range(8):
+                nr.execute_mut((1, i % 17, i + 100), tok)
+            cal = [e for e in t.events()
+                   if e["event"] == "fused-calibration"]
+            assert len(cal) == 2 and cal[1]["fenced"] == [2]
+            assert nr.stats()["fused_tier"] in ("auto:pallas_fused",
+                                                "auto:chain")
+            # unfence: the original unfenced-mask verdict still stands
+            nr.clone_replica_from(2, donor=0)
+            nr.unfence_replica(2)
+            assert nr.stats()["fused_tier"] == st["fused_tier"]
+        finally:
+            t.disable()
+
+    def test_fenced_mask_without_fenced_variant_commits_chain(
+            self, monkeypatch):
+        # an engine with no fenced kernel variant (flat vspace) has
+        # nothing to measure under a quarantine mask: the verdict must
+        # commit to chain immediately, not sit 'calibrating' forever
+        # (which would force defer off and kill the serve pipeline's
+        # overlap for the whole quarantine)
+        monkeypatch.setenv("NR_TPU_FUSED_CAL", "1")
+        from node_replication_tpu.models.vspace import make_vspace
+
+        nr = NodeReplicated(make_vspace(512, max_span=8), n_replicas=3,
+                            log_entries=512, gc_slack=64,
+                            engine="auto")
+        for i in range(8):
+            nr.execute_mut_batch([(1, i, i + 1, 2),
+                                  (1, i + 9, i, 2)], rid=0)
+        assert nr.stats()["fused_tier"] in ("auto:pallas_fused",
+                                            "auto:chain")
+        nr.fence_replica(2)
+        nr.execute_mut_batch([(1, 3, 7, 1)], rid=0)
+        # committed (to chain), NOT stuck calibrating — and the split
+        # round still defers under the quarantine
+        assert nr.stats()["fused_tier"] == "auto:chain"
+        p = nr.begin_mut_batch([(1, 5, 6, 1)], rid=0)
+        assert p.done is False  # deferred, not forced serial by timing
+        assert nr.finish_mut_batch(p) == [0]
 
     def test_grow_fleet_resets_calibration(self, monkeypatch):
         # a committed verdict was measured at the OLD (R, capacity)
@@ -510,6 +580,8 @@ class TestMkbenchKernel:
         }
         assert all(p.bit_identical for p in pts)
         fused = next(p for p in pts if p.tier == "pallas_fused")
+        # launches_per_round is the kernel.launches counter delta per
+        # timed round, not a hardcoded constant (ISSUE 15 satellite)
         assert fused.launches_per_round == 1
         assert all(p.launches_per_round == 2 for p in pts
                    if p.tier != "pallas_fused")
@@ -517,3 +589,35 @@ class TestMkbenchKernel:
         append_kernel_csv(str(tmp_path), rows)
         body = (tmp_path / KERNEL_CSV).read_text()
         assert "pallas_fused" in body and "dispatches_per_sec" in body
+
+    def test_measure_kernel_mesh_devices(self, tmp_path):
+        # the --kernel-devices axis: at devices>1 the sweep measures
+        # the MESH tier pair, bit-identity still vs the 1-device scan
+        # chain, and launches_per_round (counter-derived) holds at 1
+        # per device for the one-launch mesh-fused round
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 virtual devices")
+        from node_replication_tpu.harness.mkbench import (
+            append_kernel_csv,
+            kernel_rows,
+            measure_kernel,
+        )
+
+        pts = measure_kernel(32, 4, 32, duration_s=0.02,
+                             interpret=True, verify_rounds=2,
+                             devices=2)
+        assert {p.tier for p in pts} == {"mesh_fused", "shmap"}
+        assert all(p.bit_identical for p in pts)
+        assert all(p.devices == 2 for p in pts)
+        fused = next(p for p in pts if p.tier == "mesh_fused")
+        shmap = next(p for p in pts if p.tier == "shmap")
+        assert fused.launches_per_round == 1
+        assert shmap.launches_per_round == 2
+        rows = kernel_rows("t", pts)
+        append_kernel_csv(str(tmp_path), rows)
+        body = (tmp_path / "kernel_benchmarks.csv").read_text()
+        assert "mesh_fused" in body
+        assert "devices" in body.splitlines()[0]
+        # indivisible replica counts are rejected loudly
+        with pytest.raises(ValueError):
+            measure_kernel(32, 3, 32, interpret=True, devices=2)
