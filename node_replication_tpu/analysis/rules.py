@@ -1966,3 +1966,55 @@ def device_sync_in_assembly(mod: ModuleInfo,
                         f"{_ASSEMBLY_BLOCKING_METHODS[node.func.attr]}"
                         f"; move the sync to the completion stage",
                     )
+
+
+# --------------------------------------------------------------------------
+# unnamed-worker-thread
+# --------------------------------------------------------------------------
+
+_THREAD_NAMED_SUBSYSTEMS = frozenset(
+    {"serve", "repl", "fault", "durable", "obs"}
+)
+
+
+@rule(
+    "unnamed-worker-thread", WARNING,
+    "threading.Thread(...) without name= in a subsystem module",
+)
+def unnamed_worker_thread(mod: ModuleInfo,
+                          project: Project) -> Iterator[Diagnostic]:
+    """The sampling profiler (`obs/profile.py`) attributes host CPU
+    time by THREAD NAME: `serve-worker-r0` buckets under the
+    serve-worker role, an anonymous `Thread-7` collapses into `other`
+    and defeats the whole per-role budget (and `ServeFrontend.threads()`
+    / stack dumps go equally blind). Every thread spawned inside the
+    serve/, repl/, fault/, durable/, obs/ subsystems must carry a
+    `name=` kwarg following the role-prefix contract
+    (`obs/profile._ROLE_PREFIXES`). Scratch threads in tests, benches,
+    and examples are out of scope — only subsystem code feeds the
+    profiler's role table."""
+    parts = re.split(r"[\\/]+", mod.path)
+    if not _THREAD_NAMED_SUBSYSTEMS.intersection(parts[:-1]):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = mod.dotted(node.func)
+        is_thread = d == "threading.Thread" or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "Thread"
+        )
+        if not is_thread:
+            continue
+        # name= kwarg, positional name (3rd arg: group/target/name),
+        # or an opaque **kwargs splat all count as named
+        if any(kw.arg == "name" or kw.arg is None
+               for kw in node.keywords) or len(node.args) >= 3:
+            continue
+        yield _diag(
+            mod, node, "unnamed-worker-thread",
+            "threading.Thread without name= — anonymous threads "
+            "collapse into the profiler's 'other' role bucket; name "
+            "it with the subsystem's role prefix "
+            "(obs/profile._ROLE_PREFIXES)",
+        )

@@ -217,12 +217,31 @@ def _locked(fn):
     sync_log chains re-enter on the same thread. The nrlint
     `lock-discipline` rule understands this decorator as a whole-method
     `with self._lock` region.
+
+    Lock-wait accounting (host-budget input, ROADMAP item 2): when
+    metrics are on, a contended acquisition is timed into
+    `nr.lock.wait_s` — the combiner-lock analogue of the reference's
+    lost-CAS spin. Disabled = one `enabled` branch; the uncontended
+    fast path adds one `acquire(blocking=False)` either way, which an
+    RLock satisfies reentrantly.
     """
+    reg = get_registry()
+    m_wait = reg.histogram("nr.lock.wait_s")
 
     @functools.wraps(fn)
     def inner(self, *args, **kwargs):
-        with self._lock:
+        lock = self._lock
+        if not reg.enabled:
+            with lock:
+                return fn(self, *args, **kwargs)
+        if not lock.acquire(blocking=False):
+            t0 = time.monotonic()
+            lock.acquire()
+            m_wait.observe(time.monotonic() - t0)
+        try:
             return fn(self, *args, **kwargs)
+        finally:
+            lock.release()
 
     return inner
 

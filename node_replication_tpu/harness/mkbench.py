@@ -151,6 +151,11 @@ _SERVE_FIELDS = [
     "name", "mode", "pipeline_overlap", "clients", "rate", "duration",
     "attempts", "accepted", "completed", "shed", "deadline_miss",
     "throughput_ops", "p50_ms", "p95_ms", "p99_ms",
+    # host-profiling columns (`bench.py --serve --profile`,
+    # obs/profile.py): "" on unprofiled rows — `_append_csv`'s schema
+    # upgrade backfills "" into pre-profile files
+    "profile_hz", "profile_samples", "profile_duty_cycle",
+    "profile_attributed_frac", "profile_overhead_ratio",
 ]
 # Reference column shape (`benches/mkbench.rs:498-552`) with one addition:
 # `ops` counts *completed client ops* (the reference's Mops semantics,
@@ -884,8 +889,13 @@ def measure_serve(
     )
 
 
-def serve_rows(name: str, res: ServeResult) -> list[dict]:
-    """The SERVE_CSV row for one measurement."""
+def serve_rows(name: str, res: ServeResult,
+               profile: dict | None = None) -> list[dict]:
+    """The SERVE_CSV row for one measurement. `profile` (a
+    `bench.py --serve --profile` summary: hz / samples / duty_cycle /
+    attributed_frac / overhead_ratio) fills the profile columns;
+    unprofiled rows leave them ""."""
+    prof = profile or {}
     return [{
         "name": f"{name}/{res.name}",
         "mode": res.mode,
@@ -902,6 +912,11 @@ def serve_rows(name: str, res: ServeResult) -> list[dict]:
         "p50_ms": round(res.percentile_ms(50), 3),
         "p95_ms": round(res.percentile_ms(95), 3),
         "p99_ms": round(res.percentile_ms(99), 3),
+        "profile_hz": prof.get("hz", ""),
+        "profile_samples": prof.get("samples", ""),
+        "profile_duty_cycle": prof.get("duty_cycle", ""),
+        "profile_attributed_frac": prof.get("attributed_frac", ""),
+        "profile_overhead_ratio": prof.get("overhead_ratio", ""),
     }]
 
 

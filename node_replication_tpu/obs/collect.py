@@ -48,7 +48,13 @@ import json
 import threading
 import time
 
-from node_replication_tpu.obs.export import ExportError, scrape
+from node_replication_tpu.obs.export import (
+    ExportError,
+    profile_fetch,
+    profile_start,
+    profile_stop,
+    scrape,
+)
 
 #: default samples kept per (node, series) ring
 DEFAULT_HISTORY = 720
@@ -92,6 +98,29 @@ class _Target:
             return self.exporter.scrape_doc(since=self.seq)
         return scrape(self.host, self.port, since=self.seq,
                       timeout_s=timeout_s)
+
+    def profile_cmd(self, cmd: str, timeout_s: float, **kw) -> dict:
+        """Route one remote-capture command to this target (loopback
+        fast path for in-process exporters, socket otherwise)."""
+        if self.exporter is not None:
+            if cmd == "start":
+                return self.exporter.profile_start(
+                    hz=kw.get("hz"), max_stacks=kw.get("max_stacks"))
+            if cmd == "stop":
+                return self.exporter.profile_stop()
+            return self.exporter.profile_fetch(
+                stop=bool(kw.get("stop")))
+        if cmd == "start":
+            return profile_start(self.host, self.port,
+                                 hz=kw.get("hz"),
+                                 max_stacks=kw.get("max_stacks"),
+                                 timeout_s=timeout_s)
+        if cmd == "stop":
+            return profile_stop(self.host, self.port,
+                                timeout_s=timeout_s)
+        return profile_fetch(self.host, self.port,
+                             stop=bool(kw.get("stop")),
+                             timeout_s=max(timeout_s, 10.0))
 
 
 class FleetCollector:
@@ -310,6 +339,43 @@ class FleetCollector:
             "stats": stats,
         })
 
+    # -------------------------------------------------- remote capture
+
+    def _profile_sweep(self, cmd: str, **kw) -> dict[str, dict]:
+        """One remote-capture command across every target; a node that
+        fails answers as `{"error": ...}` under its name — profiling a
+        fleet with one sick node still profiles the rest."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            targets = list(self._targets)
+        for tgt in targets:
+            key = tgt.node_id or tgt.describe()
+            try:
+                doc = tgt.profile_cmd(cmd, self.timeout_s, **kw)
+            except (ExportError, RuntimeError, OSError,
+                    ValueError) as e:
+                tgt.errors += 1
+                doc = {"error": f"{type(e).__name__}: {e}"}
+            out[str(doc.get("node_id", key))] = doc
+        return out
+
+    def start_profiles(self, hz: float | None = None,
+                       max_stacks: int | None = None) -> dict:
+        """Start the sampling profiler on every node
+        (`obs/export.py:profile_start` per target)."""
+        return self._profile_sweep("start", hz=hz,
+                                   max_stacks=max_stacks)
+
+    def stop_profiles(self) -> dict:
+        return self._profile_sweep("stop")
+
+    def fetch_profiles(self, stop: bool = True) -> dict[str, dict]:
+        """Pull every node's profile document (snapshot + host budget
+        + folded stacks), by default stopping the samplers — the
+        fleet-wide capture `python -m ...obs.collect --profile` and
+        the autoscaler's host-budget input ride on."""
+        return self._profile_sweep("fetch", stop=stop)
+
     def _release_pid_ownership(self, tgt: _Target) -> None:
         """A failing target stops being its process's event-merge
         owner: a surviving co-resident exporter (same pid) takes over
@@ -395,19 +461,36 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--interval", type=float, default=0.5)
     p.add_argument("--seconds", type=float, default=10.0,
                    help="how long to collect (0 = one cycle)")
+    p.add_argument("--profile", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="also run every node's sampling profiler for "
+                        "SECONDS and write the fetched profiles to "
+                        "<out>.profile.json")
+    p.add_argument("--profile-hz", type=float, default=None)
     args = p.parse_args(argv)
     targets = [t.strip() for t in args.targets.split(",") if t.strip()]
     coll = FleetCollector(targets, interval_s=args.interval,
                           out_path=args.out)
+    if args.profile > 0:
+        coll.start_profiles(hz=args.profile_hz)
     if args.seconds <= 0:
         n = coll.collect_once()
+        if args.profile > 0:
+            time.sleep(args.profile)
     else:
         coll.start()
         try:
-            time.sleep(args.seconds)
+            time.sleep(max(args.seconds, args.profile))
         finally:
             coll.stop()
         n = len(coll.nodes())
+    if args.profile > 0:
+        profiles = coll.fetch_profiles(stop=True)
+        ppath = f"{args.out}.profile.json"
+        with open(ppath, "w") as fh:
+            json.dump(profiles, fh)
+        print(f"# fleet profiles ({len(profiles)} node(s)) -> {ppath}",
+              file=sys.stderr)
     st = coll.stats()
     print(f"# collected {st['merged_events']} event(s) from "
           f"{len(st['nodes'])}/{len(st['targets'])} node(s) over "

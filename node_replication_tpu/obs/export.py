@@ -21,9 +21,14 @@ scrapes with one JSON document:
 Wire format: the repo's length+CRC framing idiom (`durable/wal.py`
 framing, `repl/transport.py` on the wire) — every message is one
 frame `u32 length | u32 crc32(payload) | payload`, request and
-response payloads are JSON. One request kind (`{"cmd": "scrape"}`),
-one response; a torn frame means "reconnect and re-ask", never bad
-data.
+response payloads are JSON. Request kinds: `{"cmd": "scrape"}` (the
+original, and still the hot path), plus the remote-capture plane
+(`obs/profile.py`): `profile-start` / `profile-stop` /
+`profile-fetch` drive this process's host sampling profiler from any
+box that can reach the port, and `device-trace` arms an on-demand
+`jax.profiler.trace` device capture (answered as skipped off-TPU —
+the command is safe to broadcast fleet-wide). A torn frame means
+"reconnect and re-ask", never bad data.
 
 Scrape it three ways:
 
@@ -170,6 +175,14 @@ class MetricsExporter:
         self._threads: list[threading.Thread] = []
         self._scrapes = 0
         self._scrape_errors = 0
+        # remote-capture plane (`obs/profile.py`): the profiler this
+        # exporter serves. None until a `profile-start` command (or an
+        # owner's `attach_profiler`) creates one — the object-does-
+        # not-exist discipline survives remote control: a node nobody
+        # profiles never holds a sampler.
+        self._profiler = None
+        self._profiler_owned = False
+        self._device_trace_thread: threading.Thread | None = None
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -188,6 +201,12 @@ class MetricsExporter:
 
     # -------------------------------------------------------- lifecycle
 
+    @property
+    def accept_thread(self) -> threading.Thread:
+        """The accept-loop thread — for thread introspection
+        (`ServeFrontend.threads()`), not lifecycle."""
+        return self._accept_thread
+
     def start(self) -> None:
         if not self._accept_thread.is_alive() \
                 and not self._accept_thread.ident:
@@ -203,6 +222,10 @@ class MetricsExporter:
             self._stop = True
             conns = list(self._conns.values())
             threads = list(self._threads)
+            prof = self._profiler if self._profiler_owned else None
+            self._profiler = None
+        if prof is not None:
+            prof.stop()
         for c in conns:
             try:
                 c.close()
@@ -236,6 +259,121 @@ class MetricsExporter:
 
     def scrape_count(self) -> int:
         return self._scrapes
+
+    # ------------------------------------------------- remote capture
+
+    def attach_profiler(self, profiler) -> None:
+        """Serve an externally owned `SamplingProfiler` (e.g. the one
+        `ServeConfig(profile_hz=...)` builds) instead of creating one
+        on the first `profile-start`. Lifecycle stays with the owner:
+        `close()` does not stop an attached profiler."""
+        with self._lock:
+            self._profiler = profiler
+            self._profiler_owned = False
+
+    def profile_start(self, hz: float | None = None,
+                      max_stacks: int | None = None) -> dict:
+        """Start (or resume) this process's sampling profiler — the
+        `profile-start` command body, also callable in-process."""
+        from node_replication_tpu.obs.profile import (
+            DEFAULT_HZ,
+            DEFAULT_MAX_STACKS,
+            SamplingProfiler,
+        )
+
+        with self._lock:
+            prof = self._profiler
+            if prof is None:
+                prof = SamplingProfiler(
+                    hz=float(hz) if hz else DEFAULT_HZ,
+                    max_stacks=(int(max_stacks) if max_stacks
+                                else DEFAULT_MAX_STACKS),
+                )
+                self._profiler = prof
+                self._profiler_owned = True
+        already = prof.running
+        if not already:
+            prof.start()
+        return {"ok": True, "running": True, "already": already,
+                "hz": prof.hz, "node_id": self.node_id}
+
+    def profile_stop(self) -> dict:
+        """Stop sampling; the aggregate stays fetchable."""
+        with self._lock:
+            prof = self._profiler
+        if prof is not None:
+            prof.stop()
+        return {"ok": True, "running": False,
+                "had_profiler": prof is not None,
+                "node_id": self.node_id}
+
+    def profile_fetch(self, stop: bool = False) -> dict:
+        """The profile document: snapshot + folded text, stamped with
+        this node's identity (the `profile-fetch` command body)."""
+        from node_replication_tpu.obs.profile import (
+            folded_from_snapshot,
+            host_budget,
+        )
+
+        with self._lock:
+            prof = self._profiler
+        if prof is None:
+            raise ValueError(
+                "no profiler on this node (send profile-start first, "
+                "or attach one in-process)"
+            )
+        if stop:
+            prof.stop()
+        snap = prof.snapshot()
+        return {
+            "node_id": self.node_id,
+            "role": self.role,
+            "pid": os.getpid(),
+            "profile": snap,
+            "budget": host_budget(snap),
+            "folded": folded_from_snapshot(snap),
+        }
+
+    def device_trace(self, out_dir: str,
+                     duration_s: float = 3.0,
+                     force: bool = False) -> dict:
+        """Arm an on-demand `jax.profiler.trace` device capture into
+        `out_dir` for `duration_s` (the `device-trace` command body).
+        Guarded off-TPU: without a TPU backend (or `force`) it answers
+        `{"ok": False, "skipped": ...}` instead of spinning up a
+        capture nobody asked to pay for — the command is safe to
+        broadcast across a mixed fleet."""
+        if not out_dir:
+            raise ValueError("device-trace needs a 'dir' to write to")
+        try:
+            import jax
+        except ImportError as e:  # jax-less box: obs/ stays stdlib
+            return {"ok": False,
+                    "skipped": f"jax unavailable: {type(e).__name__}"}
+        backend = jax.default_backend()
+        if backend != "tpu" and not force:
+            return {"ok": False, "backend": backend,
+                    "skipped": f"device trace requires a TPU backend "
+                               f"(have {backend!r}); pass force to "
+                               f"capture anyway"}
+        with self._lock:
+            t = self._device_trace_thread
+            if t is not None and t.is_alive():
+                return {"ok": False, "skipped": "capture in progress"}
+
+            def run():
+                with jax.profiler.trace(str(out_dir)):
+                    time.sleep(float(duration_s))
+
+            t = threading.Thread(
+                target=run,
+                name=f"obs-device-trace-{self.node_id}",
+                daemon=True,
+            )
+            self._device_trace_thread = t
+        t.start()
+        return {"ok": True, "dir": str(out_dir),
+                "duration_s": float(duration_s), "backend": backend}
 
     # ------------------------------------------------------------ serve
 
@@ -314,11 +452,26 @@ class MetricsExporter:
 
     def _handle(self, req: bytes) -> bytes:
         msg = json.loads(req.decode("utf-8"))
-        if msg.get("cmd") != "scrape":
-            raise ValueError(f"unknown command {msg.get('cmd')!r}")
-        doc = self.scrape_doc(since=int(msg.get("since", 0)))
-        with self._lock:
-            self._scrapes += 1
+        cmd = msg.get("cmd")
+        if cmd == "scrape":
+            doc = self.scrape_doc(since=int(msg.get("since", 0)))
+            with self._lock:
+                self._scrapes += 1
+        elif cmd == "profile-start":
+            doc = self.profile_start(hz=msg.get("hz"),
+                                     max_stacks=msg.get("max_stacks"))
+        elif cmd == "profile-stop":
+            doc = self.profile_stop()
+        elif cmd == "profile-fetch":
+            doc = self.profile_fetch(stop=bool(msg.get("stop")))
+        elif cmd == "device-trace":
+            doc = self.device_trace(
+                msg.get("dir"),
+                duration_s=float(msg.get("duration_s", 3.0)),
+                force=bool(msg.get("force")),
+            )
+        else:
+            raise ValueError(f"unknown command {cmd!r}")
         return json.dumps(doc).encode()
 
     def scrape_doc(self, since: int = 0) -> dict:
@@ -360,10 +513,11 @@ class MetricsExporter:
 # ==========================================================================
 
 
-def scrape(host: str, port: int, since: int = 0,
-           timeout_s: float = 5.0) -> dict:
-    """One scrape round-trip. Raises `ExportError` on any transport
-    failure and `RuntimeError` on a server-side error document."""
+def request(host: str, port: int, msg: dict,
+            timeout_s: float = 5.0) -> dict:
+    """One framed JSON command round-trip against an exporter. Raises
+    `ExportError` on any transport failure and `RuntimeError` on a
+    server-side error document."""
     try:
         sock = socket.create_connection((host, int(port)),
                                         timeout=timeout_s)
@@ -373,9 +527,7 @@ def scrape(host: str, port: int, since: int = 0,
         ) from e
     try:
         sock.settimeout(timeout_s)
-        send_frame(sock, json.dumps(
-            {"cmd": "scrape", "since": int(since)}
-        ).encode())
+        send_frame(sock, json.dumps(msg).encode())
         doc = json.loads(recv_frame(sock).decode("utf-8"))
     finally:
         try:
@@ -385,6 +537,54 @@ def scrape(host: str, port: int, since: int = 0,
     if "error" in doc and "node_id" not in doc:
         raise RuntimeError(f"exporter error: {doc['error']}")
     return doc
+
+
+def scrape(host: str, port: int, since: int = 0,
+           timeout_s: float = 5.0) -> dict:
+    """One scrape round-trip (see `request` for the error contract)."""
+    return request(host, port,
+                   {"cmd": "scrape", "since": int(since)},
+                   timeout_s=timeout_s)
+
+
+def profile_start(host: str, port: int, hz: float | None = None,
+                  max_stacks: int | None = None,
+                  timeout_s: float = 5.0) -> dict:
+    """Start the remote node's sampling profiler (`obs/profile.py`)."""
+    msg: dict = {"cmd": "profile-start"}
+    if hz is not None:
+        msg["hz"] = float(hz)
+    if max_stacks is not None:
+        msg["max_stacks"] = int(max_stacks)
+    return request(host, port, msg, timeout_s=timeout_s)
+
+
+def profile_stop(host: str, port: int,
+                 timeout_s: float = 5.0) -> dict:
+    """Stop the remote node's sampling profiler (aggregate survives)."""
+    return request(host, port, {"cmd": "profile-stop"},
+                   timeout_s=timeout_s)
+
+
+def profile_fetch(host: str, port: int, stop: bool = False,
+                  timeout_s: float = 10.0) -> dict:
+    """Fetch the remote node's profile document (snapshot + host
+    budget + folded stacks); `stop=True` halts sampling first."""
+    return request(host, port,
+                   {"cmd": "profile-fetch", "stop": bool(stop)},
+                   timeout_s=timeout_s)
+
+
+def device_trace(host: str, port: int, out_dir: str,
+                 duration_s: float = 3.0, force: bool = False,
+                 timeout_s: float = 5.0) -> dict:
+    """Arm a `jax.profiler.trace` device capture on the remote node
+    (answered as skipped off-TPU unless `force`)."""
+    return request(host, port,
+                   {"cmd": "device-trace", "dir": str(out_dir),
+                    "duration_s": float(duration_s),
+                    "force": bool(force)},
+                   timeout_s=timeout_s)
 
 
 # ==========================================================================

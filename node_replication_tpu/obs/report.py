@@ -754,6 +754,65 @@ def analyze(events: list[dict]) -> dict:
             ],
         }
 
+    # host budget section: per-stage host-CPU attribution from
+    # profile-summary events (obs/profile.SamplingProfiler.emit_summary)
+    # joined with the spans the profiler's stages mirror — the direct
+    # input to ROADMAP item 2 (why the serve host path acks 1.4k ops/s
+    # while the device sustains millions of dispatches)
+    host_budget = None
+    psums = [e for e in events if e.get("event") == "profile-summary"]
+    if psums:
+        stage_samples: dict[str, int] = defaultdict(int)
+        role_samples: dict[str, int] = defaultdict(int)
+        total = 0
+        busy_weighted = 0.0
+        for e in psums:
+            n = int(e.get("thread_samples", 0))
+            total += n
+            busy_weighted += float(e.get("busy_frac", 0.0)) * n
+            for stage, s in (e.get("stages") or {}).items():
+                stage_samples[str(stage)] += int(s)
+            for role, s in (e.get("roles") or {}).items():
+                role_samples[str(role)] += int(s)
+        other = stage_samples.get("other", 0)
+        # join each budget stage with the wall-clock spans that time
+        # the same work, so "fraction of host samples" sits next to
+        # "seconds of span time" for the stages both planes cover
+        _span_of_stage = {
+            "append": ("append", "fused-round", "serve-batch"),
+            "encode": ("serve-assemble",),
+            "fsync": ("wal-sync",),
+        }
+        stages = {}
+        for stage, n in sorted(stage_samples.items(),
+                               key=lambda kv: -kv[1]):
+            row = {"samples": n,
+                   "frac": n / total if total else 0.0}
+            span_total = sum(
+                span_stats[s]["total_s"]
+                for s in _span_of_stage.get(stage, ())
+                if s in span_stats
+            )
+            if span_total:
+                row["span_total_s"] = span_total
+            stages[stage] = row
+        host_budget = {
+            "profiles": len(psums),
+            "thread_samples": total,
+            "hz": max(float(e.get("hz", 0.0)) for e in psums),
+            "duty_cycle": max(float(e.get("duty_cycle", 0.0))
+                              for e in psums),
+            "busy_frac": busy_weighted / total if total else 0.0,
+            "overflow_drops": sum(int(e.get("overflow_drops", 0))
+                                  for e in psums),
+            "stages": stages,
+            "roles": dict(sorted(role_samples.items(),
+                                 key=lambda kv: -kv[1])),
+            "attributed_frac": (
+                (total - other) / total if total else 0.0
+            ),
+        }
+
     return {
         "n_events": len(events),
         "event_counts": dict(counts),
@@ -769,6 +828,7 @@ def analyze(events: list[dict]) -> dict:
         "fleet": fleet,
         "mesh": mesh,
         "kernels": kernels,
+        "host_budget": host_budget,
         "stalls": [
             {"where": where, "log": log, **{k: (sorted(v)
                                                if isinstance(v, set)
@@ -788,7 +848,7 @@ def render(report: dict, out=None) -> None:
     # below is absent because the trace holds none of its events, not
     # because the report crashed on partial data
     _sections = ("serve", "fault", "durability", "replication",
-                 "fleet", "mesh", "kernels")
+                 "fleet", "mesh", "kernels", "host_budget")
     present = [s for s in _sections if report.get(s)]
     absent = [s for s in _sections if not report.get(s)]
     w(f"sections: {', '.join(present) if present else '(core only)'}"
@@ -1087,6 +1147,30 @@ def render(report: dict, out=None) -> None:
             w(f"  winner selection @ window {c['window']}: "
               f"{c['winner']} (fused {_fmt_s(c['fused_s'])} vs chain "
               f"{_fmt_s(c['chain_s'])})\n")
+
+    hb = report.get("host_budget")
+    if hb:
+        w("\n== host budget ==\n")
+        w(f"  {hb['thread_samples']} thread-sample(s) from "
+          f"{hb['profiles']} profile(s) at {hb['hz']:g} Hz   "
+          f"host busy {100.0 * hb['busy_frac']:.0f}%   "
+          f"profiler duty {100.0 * hb['duty_cycle']:.2f}%"
+          + (f"   ({hb['overflow_drops']} overflow drop(s))"
+             if hb.get("overflow_drops") else "") + "\n")
+        w(f"  {'stage':<16} {'samples':>8} {'share':>7} {'span total':>11}\n")
+        for stage, s in hb["stages"].items():
+            bar = "#" * max(1, round(30 * s["frac"]))
+            span_s = (_fmt_s(s["span_total_s"])
+                      if "span_total_s" in s else "-")
+            w(f"  {stage:<16} {s['samples']:>8} "
+              f"{100.0 * s['frac']:>6.1f}% {span_s:>11}  {bar}\n")
+        w(f"  attributed to named stages: "
+          f"{100.0 * hb['attributed_frac']:.1f}%\n")
+        roles = hb.get("roles") or {}
+        if roles:
+            w("  samples by role: "
+              + "   ".join(f"{r}={n}" for r, n in roles.items())
+              + "\n")
 
     w("\n== stall report ==\n")
     if not report["stalls"]:

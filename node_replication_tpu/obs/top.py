@@ -7,7 +7,9 @@ Runs a `FleetCollector` (`obs/collect.py`) against the given
 exporters and redraws one frame per interval: a row per node —
 role, applied position, ship/apply/relay lag, adaptive admission
 limit, shed count and SLO burn (shed + deadline-missed over
-accepted), brownout/circuit state — ordered primary → relays →
+accepted), host-busy % (the sampling profiler's
+`obs.host.busy_frac` gauge, "-" on unprofiled nodes),
+brownout/circuit state — ordered primary → relays →
 followers so the table reads as the tree.
 
 Rendering is a PURE function (`render_frame(latest) -> str`), so the
@@ -32,7 +34,7 @@ from node_replication_tpu.obs.collect import FleetCollector
 _ROLE_ORDER = {"primary": 0, "relay": 1, "follower": 2}
 
 _COLUMNS = ("node", "role", "applied", "ship-lag", "apply-lag",
-            "limit", "shed", "burn", "p99", "state")
+            "limit", "shed", "burn", "host", "p99", "state")
 
 
 def _num(d, *path):
@@ -76,6 +78,12 @@ def node_row(summary: dict) -> dict:
         burn = ((shed or 0) + (missed or 0)) / max(1, accepted)
     lat = metrics.get("serve.request.latency_s")
     p99 = lat.get("p99") if isinstance(lat, dict) else None
+    # host-busy %: published by the node's sampling profiler
+    # (obs/profile.py `obs.host.busy_frac` gauge); "-" when the node
+    # isn't profiled — the gauge, like the profiler, does not exist
+    busy = metrics.get("obs.host.busy_frac")
+    if not isinstance(busy, (int, float)):
+        busy = None
     state = []
     if overload.get("brownout"):
         state.append("BROWNOUT")
@@ -103,6 +111,7 @@ def node_row(summary: dict) -> dict:
         "limit": _fmt(limit),
         "shed": _fmt(shed),
         "burn": _fmt(burn, pct=True) if burn is not None else "-",
+        "host": _fmt(busy, pct=True) if busy is not None else "-",
         "p99": (f"{float(p99) * 1e3:.1f}ms"
                 if isinstance(p99, (int, float)) else "-"),
         "state": " ".join(state) or "ok",

@@ -190,6 +190,13 @@ class ServeConfig:
     #: exporter identity label (defaults to $NR_TPU_NODE_ID or
     #: `<role>-<pid>`); only read when `obs_port` is set
     obs_node_id: str | None = None
+    #: host-path sampling profiler (`obs/profile.py`): a rate in Hz
+    #: starts a `SamplingProfiler` with the frontend (per-role folded
+    #: stacks, duty-cycle gauge, host-budget input; attached to the
+    #: exporter's `profile-fetch` when `obs_port` is also set); None
+    #: (default) builds NOTHING — the object does not exist, zero
+    #: hot-path branches
+    profile_hz: float | None = None
 
     def __post_init__(self):
         if self.queue_depth < 1:
@@ -213,6 +220,11 @@ class ServeConfig:
         if not 0 <= self.wal_lag_low < self.wal_lag_high:
             raise ValueError(
                 "wal lag watermarks need 0 <= low < high"
+            )
+        if self.profile_hz is not None and not self.profile_hz > 0:
+            raise ValueError(
+                f"profile_hz must be > 0 (or None to not build a "
+                f"profiler at all); got {self.profile_hz}"
             )
         if (self.overload is not None
                 and self.overload.target_delay_s
@@ -421,10 +433,19 @@ class _SubmissionQueue:
 
     __slots__ = ("_lock", "_items", "_depth", "_closed", "_in_service",
                  "accepted", "shed", "completed", "deadline_missed",
-                 "evicted", "shed_by_prio", "priority_inversions")
+                 "evicted", "shed_by_prio", "priority_inversions",
+                 "_reg", "_m_wait", "_m_linger")
 
     def __init__(self, depth: int):
         self._lock = threading.Condition()
+        # queue-wait accounting (host-budget input): how long the
+        # worker sat on the condition before the first op arrived, and
+        # how long it lingered for the batch to fill. One `enabled`
+        # branch per take_batch when metrics are off (obs/metrics.py
+        # cost rule); handles are created once, not per call.
+        self._reg = get_registry()
+        self._m_wait = self._reg.histogram("serve.queue.wait_s")
+        self._m_linger = self._reg.histogram("serve.queue.linger_s")
         self._items: tuple[deque[_Request], ...] = tuple(
             deque() for _ in PRIORITIES
         )
@@ -535,8 +556,15 @@ class _SubmissionQueue:
         class (CRITICAL first), FIFO within each class."""
         clock = get_clock()
         with self._lock:
+            t_wait = (
+                clock.now()
+                if self._reg.enabled and not self._depth_unlocked()
+                and not self._closed else None
+            )
             while not self._depth_unlocked() and not self._closed:
                 clock.wait(self._lock)
+            if t_wait is not None:
+                self._m_wait.observe(clock.now() - t_wait)
             if not self._depth_unlocked():
                 return None  # closed and empty: worker exits
             if (linger_s > 0 and self._depth_unlocked() < max_ops
@@ -548,6 +576,12 @@ class _SubmissionQueue:
                     if rem <= 0:
                         break
                     clock.wait(self._lock, rem)
+                if self._reg.enabled:
+                    # t_end - linger_s is the linger start; no extra
+                    # clock call was spent on the disabled path
+                    self._m_linger.observe(
+                        clock.now() - (t_end - linger_s)
+                    )
             batch: list[_Request] = []
             for d in self._items:
                 while d and len(batch) < max_ops:
@@ -804,6 +838,20 @@ class ServeFrontend:
                 port=self.cfg.obs_port,
             )
             self.exporter.add_stats("serve", self.stats)
+        #: host sampling profiler (`ServeConfig.profile_hz`,
+        #: `obs/profile.py`): same existence discipline as the
+        #: exporter — None by default, so profiling costs nothing
+        #: unless a rate was asked for
+        self.profiler = None
+        if self.cfg.profile_hz is not None:
+            from node_replication_tpu.obs.profile import SamplingProfiler
+
+            self.profiler = SamplingProfiler(hz=self.cfg.profile_hz)
+            self.profiler.start()
+            if self.exporter is not None:
+                # remote capture serves the frontend's profiler; its
+                # lifecycle stays here (exporter.close won't stop it)
+                self.exporter.attach_profiler(self.profiler)
         if auto_start:
             self.start()
 
@@ -924,6 +972,36 @@ class ServeFrontend:
     def rids(self) -> list[int]:
         with self._lock:  # grow() can resize the dict mid-iteration
             return sorted(self._queues)
+
+    def threads(self) -> dict[str, list[str]]:
+        """Live worker threads by profiler role (`obs.profile.role_of`)
+        — the introspection face of the thread-name contract the
+        sampling profiler attributes by. Covers the frontend's own
+        workers/completers plus the exporter accept thread and the
+        profiler sampler when those exist. Names are unique (each
+        embeds its rid or node id), so the dict is loss-free."""
+        from node_replication_tpu.obs.profile import role_of
+
+        with self._lock:
+            live = [
+                t for t in (list(self._workers.values())
+                            + list(self._completers.values()))
+                if t.is_alive()
+            ]
+        for extra in (
+            self.exporter.accept_thread
+            if self.exporter is not None else None,
+            self.profiler.thread
+            if self.profiler is not None else None,
+        ):
+            if extra is not None and extra.is_alive():
+                live.append(extra)
+        out: dict[str, list[str]] = {}
+        for t in live:
+            out.setdefault(role_of(t.name), []).append(t.name)
+        for names in out.values():
+            names.sort()
+        return out
 
     def grow(self, k: int = 1) -> list[int]:
         """Add `k` replicas to the live fleet (`grow_fleet`) and start
@@ -1135,6 +1213,8 @@ class ServeFrontend:
         reg = get_registry()
         for rid, _ in queues:
             reg.remove(f"serve.queue_depth.r{rid}", gauges.get(rid))
+        if self.profiler is not None:
+            self.profiler.stop()
         if self.exporter is not None:
             self.exporter.close()
         get_tracer().emit("serve-close", drained=drain)

@@ -84,7 +84,8 @@ def drive(e, n_threads, mixed_logs, keyspace):
         except Exception as ex:  # pragma: no cover
             errs.append(ex)
 
-    ts = [threading.Thread(target=worker, args=(g,))
+    ts = [threading.Thread(target=worker, args=(g,),
+                           name=f"tsan-worker-{g}")
           for g in range(n_threads)]
     for t in ts:
         t.start()

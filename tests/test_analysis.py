@@ -1513,3 +1513,62 @@ class TestDeviceSyncInAssembly:
                 return arr.item()
         """)
         assert not firing(diags, "device-sync-in-assembly")
+
+
+class TestUnnamedWorkerThread:
+    """Rule 20: anonymous threads inside the serve/repl/fault/
+    durable/obs subsystems collapse into the sampling profiler's
+    'other' role bucket (`obs/profile.role_of`), so subsystem spawns
+    must carry `name=`. Tests/benches/examples are out of scope."""
+
+    def _lint_in(self, tmp_path, subdir, source):
+        import textwrap
+        d = tmp_path / subdir
+        d.mkdir(parents=True, exist_ok=True)
+        p = d / "snippet.py"
+        p.write_text(textwrap.dedent(source))
+        diags, errors = run_lint([str(p)])
+        assert not errors, errors
+        return diags
+
+    def test_unnamed_thread_in_serve_fires(self, tmp_path):
+        diags = self._lint_in(tmp_path, "serve", """
+            import threading
+
+            def spawn(q):
+                t = threading.Thread(target=q.drain, daemon=True)
+                t.start()
+                return t
+        """)
+        assert len(firing(diags, "unnamed-worker-thread")) == 1
+
+    def test_unnamed_thread_in_obs_fires(self, tmp_path):
+        diags = self._lint_in(tmp_path, "obs", """
+            from threading import Thread
+
+            def spawn(fn):
+                return Thread(target=fn)
+        """)
+        assert len(firing(diags, "unnamed-worker-thread")) == 1
+
+    def test_named_thread_clean(self, tmp_path):
+        diags = self._lint_in(tmp_path, "repl", """
+            import threading
+
+            def spawn(rid, loop):
+                return threading.Thread(
+                    target=loop, name=f"repl-apply-{rid}", daemon=True,
+                )
+        """)
+        assert not firing(diags, "unnamed-worker-thread")
+
+    def test_out_of_scope_module_clean(self, tmp_path):
+        # scratch threads in test/bench-style modules don't feed the
+        # profiler's role table — out of the rule's scope
+        diags = lint_src(tmp_path, """
+            import threading
+
+            def spawn(fn):
+                return threading.Thread(target=fn)
+        """)
+        assert not firing(diags, "unnamed-worker-thread")
