@@ -253,6 +253,11 @@ class Project:
                 self.symbols[f"{m.module_name}.{name}"] = (m, node)
         self.dispatch_fns: set[int] = set()  # id() of def nodes
         self._traced: set[int] = set()       # id() of function scopes
+        # id(scope) -> name -> [("def"|"alias", node), ...] in walk
+        # order; built lazily so each scope body is walked ONCE no
+        # matter how many names resolve inside it (the naive re-walk
+        # was quadratic and dominated whole-package build time)
+        self._scope_index: dict[int, dict[str, list]] = {}
         self._infer()
 
     # ------------------------------------------------------- queries
@@ -279,6 +284,32 @@ class Project:
             self._traced.add(id(fn))
             worklist.append(fn)
 
+    def _scope_names(self, scope: ast.AST) -> dict[str, list]:
+        """Name -> [(kind, node)] for defs and single-Name-target
+        assigns anywhere under `scope`, in the same statement-major
+        walk order the resolver historically observed."""
+        idx = self._scope_index.get(id(scope))
+        if idx is None:
+            idx = {}
+            body = scope.body if isinstance(scope.body, list) else []
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        idx.setdefault(node.name, []).append(
+                            ("def", node)
+                        )
+                    elif (
+                        isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                    ):
+                        idx.setdefault(node.targets[0].id, []).append(
+                            ("alias", node)
+                        )
+            self._scope_index[id(scope)] = idx
+        return idx
+
     def _resolve_callable_name(
         self, mod: ModuleInfo, at: ast.AST, name: str,
         seen: set[str] | None = None,
@@ -293,54 +324,42 @@ class Project:
         out: list[ast.AST] = []
         scopes = list(mod.enclosing_functions(at))
         for scope in scopes:
-            body = (
-                scope.body if isinstance(scope.body, list) else []
-            )
-            for stmt in body:
-                for node in ast.walk(stmt):
-                    if isinstance(node, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)) and (
-                        node.name == name
-                    ):
-                        out.append(node)
-                    elif (
-                        isinstance(node, ast.Assign)
-                        and len(node.targets) == 1
-                        and isinstance(node.targets[0], ast.Name)
-                        and node.targets[0].id == name
-                    ):
-                        # alias: union every name its value mentions.
-                        # A name being CALLED in the value
-                        # (`replay = make_replay(...)`) is a maker run
-                        # at setup time: the alias denotes whatever it
-                        # RETURNS, so contribute the maker's nested
-                        # defs, not the maker's own host-side body.
-                        called = {
-                            id(c.func)
-                            for c in ast.walk(node.value)
-                            if isinstance(c, ast.Call)
-                            and isinstance(c.func, ast.Name)
-                        }
-                        for sub in ast.walk(node.value):
-                            if isinstance(sub, ast.Name) and isinstance(
-                                sub.ctx, ast.Load
-                            ):
-                                hits = self._resolve_callable_name(
-                                    mod, at, sub.id, seen
-                                )
-                                if id(sub) in called:
-                                    for fn in hits:
-                                        out.extend(
-                                            s for s in ast.walk(fn)
-                                            if s is not fn
-                                            and isinstance(
-                                                s, _FUNC_NODES
-                                            )
+            for kind, node in self._scope_names(scope).get(name, ()):
+                if kind == "def":
+                    out.append(node)
+                else:
+                    # alias: union every name its value mentions.
+                    # A name being CALLED in the value
+                    # (`replay = make_replay(...)`) is a maker run
+                    # at setup time: the alias denotes whatever it
+                    # RETURNS, so contribute the maker's nested
+                    # defs, not the maker's own host-side body.
+                    called = {
+                        id(c.func)
+                        for c in ast.walk(node.value)
+                        if isinstance(c, ast.Call)
+                        and isinstance(c.func, ast.Name)
+                    }
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, ast.Load
+                        ):
+                            hits = self._resolve_callable_name(
+                                mod, at, sub.id, seen
+                            )
+                            if id(sub) in called:
+                                for fn in hits:
+                                    out.extend(
+                                        s for s in ast.walk(fn)
+                                        if s is not fn
+                                        and isinstance(
+                                            s, _FUNC_NODES
                                         )
-                                else:
-                                    out.extend(hits)
-                            elif isinstance(sub, ast.Lambda):
-                                out.append(sub)
+                                    )
+                            else:
+                                out.extend(hits)
+                        elif isinstance(sub, ast.Lambda):
+                            out.append(sub)
             if out:
                 return out
         if name in mod.top_defs:

@@ -19,7 +19,9 @@ typos cannot disarm the gate.
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import re
 import sys
 from typing import Iterable
 
@@ -33,6 +35,8 @@ from node_replication_tpu.analysis.rules import (
     SEVERITY_ORDER,
     WARNING,
 )
+from node_replication_tpu.analysis import concurrency  # registers the
+#   nrcheck-* and concurrency rules as an import side effect
 
 
 def collect_files(paths: Iterable[str]) -> list[str]:
@@ -60,16 +64,10 @@ def _suppressed_by(mod: ModuleInfo, diag: Diagnostic) -> bool:
     return False
 
 
-def run_lint(
+def build_project(
     paths: Iterable[str],
-    select: set[str] | None = None,
-) -> tuple[list[Diagnostic], list[str]]:
-    """Run every (or the selected) rule over `paths`.
-
-    Returns `(diagnostics, errors)`: diagnostics carry a `suppressed`
-    flag already resolved against the source comments; `errors` are
-    files that failed to parse (themselves a gate failure).
-    """
+) -> tuple[list[ModuleInfo], Project, list[str]]:
+    """Parse every file under `paths` into one analyzable Project."""
     errors: list[str] = []
     modules: list[ModuleInfo] = []
     for path in collect_files(paths):
@@ -77,7 +75,24 @@ def run_lint(
             modules.append(ModuleInfo(path))
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             errors.append(f"{path}: {e}")
-    project = Project(modules)
+    return modules, Project(modules), errors
+
+
+def run_lint(
+    paths: Iterable[str],
+    select: set[str] | None = None,
+    project: Project | None = None,
+) -> tuple[list[Diagnostic], list[str]]:
+    """Run every (or the selected) rule over `paths`.
+
+    Returns `(diagnostics, errors)`: diagnostics carry a `suppressed`
+    flag already resolved against the source comments; `errors` are
+    files that failed to parse (themselves a gate failure).
+    """
+    if project is None:
+        modules, project, errors = build_project(paths)
+    else:
+        modules, errors = project.modules, []
     diags: list[Diagnostic] = []
     for mod in modules:
         for rule in RULES.values():
@@ -117,6 +132,67 @@ def run_lint(
     return diags, errors
 
 
+_SUPPRESS_LINE_RE = re.compile(
+    r"#\s*nrlint:\s*disable\s*=\s*"
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)(.*)$"
+)
+
+
+def audit_suppressions(paths: Iterable[str]) -> int:
+    """`--suppressions`: list every `# nrlint: disable=` with file:line,
+    flag STALE entries (the named rule no longer fires on the covered
+    lines) and UNJUSTIFIED entries (no trailing `— why` text and no
+    explanatory comment on the line above). Exit 1 when either class
+    is non-empty — a suppression must stay load-bearing and reviewed.
+    """
+    files = collect_files(paths)
+    diags, errors = run_lint(files)
+    for e in errors:
+        print(f"parse error: {e}")
+    # every diagnostic (suppressed or not) a rule produced, keyed so a
+    # suppression at line L is "used" by a firing at L or L+1
+    fired: set[tuple[str, str, int]] = set()
+    for d in diags:
+        fired.add((d.path, d.rule_id, d.line))
+    n_stale = n_unjust = n_total = 0
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            continue
+        for i, text in enumerate(lines, start=1):
+            m = _SUPPRESS_LINE_RE.search(text)
+            if not m:
+                continue
+            ids = [s.strip() for s in m.group(1).split(",")]
+            trailing = m.group(2).strip(" -—:\t")
+            above = lines[i - 2].strip() if i >= 2 else ""
+            justified = bool(trailing) or (
+                above.startswith("#")
+                and not _SUPPRESS_LINE_RE.search(above)
+            )
+            for rid in ids:
+                n_total += 1
+                notes = []
+                if rid in RULES and not (
+                    (path, rid, i) in fired or (path, rid, i + 1) in fired
+                ):
+                    notes.append("STALE: rule no longer fires here")
+                    n_stale += 1
+                if not justified:
+                    notes.append(
+                        "UNJUSTIFIED: add `— why` or a comment above")
+                    n_unjust += 1
+                note = f"  [{'; '.join(notes)}]" if notes else ""
+                print(f"{path}:{i}: disable={rid}{note}")
+    print(
+        f"nrlint --suppressions: {n_total} suppression(s), "
+        f"{n_stale} stale, {n_unjust} unjustified"
+    )
+    return 1 if n_stale or n_unjust or errors else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m node_replication_tpu.analysis.lint",
@@ -136,6 +212,16 @@ def main(argv: list[str] | None = None) -> int:
                     help="also print suppressed diagnostics")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
+    ap.add_argument("--suppressions", action="store_true",
+                    help="audit mode: list every suppression, flag "
+                         "stale and unjustified ones")
+    ap.add_argument("--lockgraph-out", default=None, metavar="PATH",
+                    help="write the static lock-order graph "
+                         "(nodes/edges/cycles) as JSON")
+    ap.add_argument("--check-dynamic", default=None, metavar="PATH",
+                    help="verify a runtime lockgraph dump "
+                         "(NR_TPU_LOCKGRAPH) is a subgraph of the "
+                         "static graph")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -143,6 +229,9 @@ def main(argv: list[str] | None = None) -> int:
         for rid, r in sorted(RULES.items()):
             print(f"{rid:<{width}}  {r.severity:<7}  {r.summary}")
         return 0
+
+    if args.suppressions:
+        return audit_suppressions(args.paths)
 
     select = (
         {s.strip() for s in args.select.split(",") if s.strip()}
@@ -156,9 +245,40 @@ def main(argv: list[str] | None = None) -> int:
             return 2
 
     files = collect_files(args.paths)
-    diags, errors = run_lint(files, select=select)
+    modules, project, errors = build_project(files)
+    diags, _ = run_lint(files, select=select, project=project)
     for e in errors:
         print(f"parse error: {e}")
+
+    graph_rc = 0
+    if args.lockgraph_out or args.check_dynamic:
+        analysis = concurrency.analyze(project)
+        if args.lockgraph_out:
+            with open(args.lockgraph_out, "w") as f:
+                json.dump(analysis.graph_json(), f, indent=1,
+                          sort_keys=True)
+                f.write("\n")
+            print(f"nrlint: static lock-order graph "
+                  f"({len(analysis.edge_list())} edge(s)) -> "
+                  f"{args.lockgraph_out}")
+        if args.check_dynamic:
+            try:
+                with open(args.check_dynamic) as f:
+                    dyn = json.load(f).get("edges", [])
+            except (OSError, ValueError) as e:
+                print(f"nrlint: cannot read dynamic lockgraph "
+                      f"{args.check_dynamic}: {e}")
+                return 2
+            violations = analysis.check_dynamic(dyn)
+            for v in violations:
+                print(f"nrlint: {v}")
+            print(
+                f"nrlint --check-dynamic: {len(dyn)} dynamic edge(s), "
+                f"{len(violations)} missing from the static graph, "
+                f"{len(analysis.cycles)} static cycle(s)"
+            )
+            if violations or analysis.cycles:
+                graph_rc = 1
 
     threshold = SEVERITY_ORDER[args.min_severity]
     failing = [
@@ -177,7 +297,7 @@ def main(argv: list[str] | None = None) -> int:
         f"{n_suppressed} suppressed, {len(diags)} total "
         f"across {len(files)} file(s)"
     )
-    return 1 if failing or errors else 0
+    return 1 if failing or errors or graph_rc else 0
 
 
 if __name__ == "__main__":

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import logging
 import threading
+
+from node_replication_tpu.analysis.locks import make_rlock
 import time
 from collections import deque
 from functools import partial
@@ -151,7 +153,7 @@ class MultiLogReplicated(_FusedTier):
         # Combiner lock (`replica._locked`): one combiner pass at a
         # time across all logs; reentrant so watchdog gc_callbacks can
         # re-enter sync_log on the same thread.
-        self._lock = threading.RLock()
+        self._lock = make_rlock("MultiLogReplicated._lock")
         self._threads_per_replica = [0] * n_replicas
         # staged ops: (rid, tid) -> deque[(log, opcode, args)]
         self._pending: dict[tuple[int, int], deque] = {}

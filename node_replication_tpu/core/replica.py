@@ -39,6 +39,8 @@ import logging
 import os
 import statistics
 import threading
+
+from node_replication_tpu.analysis.locks import make_rlock
 import time
 from collections import deque
 from functools import partial
@@ -203,6 +205,17 @@ class ReplicaFencedError(RuntimeError):
         self.rid = rid
 
 
+# Locked methods emit trace events and update instruments; the tracer
+# and instrument handles come from module-level get_* accessors the
+# analyzer cannot type through, so the nesting is declared:
+# nrcheck: lock-order NodeReplicated._lock -> Tracer._lock — locked methods emit trace events
+# nrcheck: lock-order MultiLogReplicated._lock -> Tracer._lock — CNR locked methods emit trace events
+# nrcheck: lock-order NodeReplicated._lock -> Counter._lock — locked methods bump counters
+# nrcheck: lock-order MultiLogReplicated._lock -> Counter._lock — CNR locked methods bump counters
+# nrcheck: lock-order NodeReplicated._lock -> Histogram._lock — locked methods observe durations
+# nrcheck: lock-order MultiLogReplicated._lock -> Histogram._lock — CNR locked methods observe durations
+# nrcheck: lock-order NodeReplicated._lock -> WriteAheadLog._lock — the combiner round journals the batch into the attached WAL
+# nrcheck: lock-order MultiLogReplicated._lock -> WriteAheadLog._lock — same journaling through the CNR wrapper
 def _locked(fn):
     """Run a method under the instance's combiner lock (`self._lock`).
 
@@ -603,7 +616,7 @@ class NodeReplicated(_FusedTier):
 
         # Combiner lock (see `_locked`): guards log/states/cursor and
         # context bookkeeping against concurrent OS-thread callers.
-        self._lock = threading.RLock()
+        self._lock = make_rlock("NodeReplicated._lock")
         self._contexts: dict[tuple[int, int], Context] = {}
         self._threads_per_replica = [0] * n_replicas
         # Appended-but-unanswered ops per replica: deque[(logical_pos, tid)].

@@ -38,6 +38,8 @@ from __future__ import annotations
 
 import hashlib
 import threading
+
+from node_replication_tpu.analysis.locks import make_lock
 from collections import Counter
 
 import numpy as np
@@ -132,7 +134,8 @@ class HealthTracker:
             raise ValueError("need at least one replica")
         if exc_threshold < 1 or stall_threshold < 1:
             raise ValueError("thresholds must be >= 1")
-        self._lock = threading.Lock()
+        # nrcheck: lock-order HealthTracker._lock -> Tracer._lock — state transitions emit trace events under the lock
+        self._lock = make_lock("HealthTracker._lock")
         self._states = [HEALTHY] * n_replicas
         self._exc_counts = [0] * n_replicas
         self._stall_counts = [0] * n_replicas

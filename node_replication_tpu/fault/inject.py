@@ -51,6 +51,8 @@ import dataclasses
 import random
 import threading
 
+from node_replication_tpu.analysis.locks import make_lock
+
 from node_replication_tpu.obs.metrics import get_registry
 from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer
@@ -162,7 +164,7 @@ class FaultPlan:
     def __init__(self, specs=(), seed: int = 0):
         self.specs = tuple(specs)
         self.seed = int(seed)
-        self._lock = threading.Lock()
+        self._lock = make_lock("FaultPlan._lock")
         self._hits = {site: 0 for site in SITES}
         self._rid_hits: dict[tuple[str, int], int] = {}
         self._fired_counts = [0] * len(self.specs)

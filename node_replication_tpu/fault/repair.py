@@ -42,6 +42,8 @@ from __future__ import annotations
 import logging
 import threading
 
+from node_replication_tpu.analysis.locks import make_lock
+
 from node_replication_tpu.fault.health import (
     HEALTHY,
     QUARANTINED,
@@ -113,7 +115,7 @@ class ReplicaLifecycleManager:
         self.frontend = frontend
         self.health = health or HealthTracker(nr.n_replicas)
         self.repairs: list[dict] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("ReplicaLifecycleManager._lock")
         self._medics: list[threading.Thread] = []
         if frontend is not None:
             frontend.on_replica_failed = self._on_worker_failure

@@ -46,6 +46,8 @@ from __future__ import annotations
 import collections
 import json
 import threading
+
+from node_replication_tpu.analysis.locks import make_lock
 import time
 
 from node_replication_tpu.obs.export import (
@@ -153,7 +155,7 @@ class FleetCollector:
         self.timeout_s = float(timeout_s)
         self.out_path = out_path
         self._history = int(history)
-        self._lock = threading.Lock()
+        self._lock = make_lock("FleetCollector._lock")
         self._series: dict[tuple[str, str], collections.deque] = {}
         self._latest: dict[str, dict] = {}
         # several exporters can live in ONE process (in-process relay

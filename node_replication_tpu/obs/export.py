@@ -58,6 +58,8 @@ import os
 import socket
 import struct
 import threading
+
+from node_replication_tpu.analysis.locks import make_lock
 import time
 import zlib
 
@@ -167,7 +169,7 @@ class MetricsExporter:
         self.accept_timeout_s = float(accept_timeout_s)
         self.io_timeout_s = float(io_timeout_s)
 
-        self._lock = threading.Lock()
+        self._lock = make_lock("MetricsExporter._lock")
         self._stats_fns: dict[str, object] = dict(stats_fns or {})
         self._stop = False
         self._conns: dict[int, socket.socket] = {}
@@ -258,6 +260,7 @@ class MetricsExporter:
             self._stats_fns[str(name)] = fn
 
     def scrape_count(self) -> int:
+        # nrcheck: unshared — lock-free poll; one int load
         return self._scrapes
 
     # ------------------------------------------------- remote capture

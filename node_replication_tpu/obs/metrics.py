@@ -31,6 +31,8 @@ import bisect
 import os
 import threading
 
+from node_replication_tpu.analysis.locks import make_lock
+
 # Default histogram buckets for durations in seconds: 1us .. ~100s,
 # roughly x4 per step (14 buckets; small enough to snapshot cheaply).
 DURATION_BUCKETS_S = tuple(1e-6 * 4**i for i in range(14))
@@ -47,7 +49,7 @@ class Counter:
     def __init__(self, name: str, reg: "MetricsRegistry"):
         self.name = name
         self._reg = reg
-        self._lock = threading.Lock()
+        self._lock = make_lock("Counter._lock")
         self._value = 0
 
     def inc(self, n: int = 1) -> None:
@@ -58,6 +60,7 @@ class Counter:
 
     @property
     def value(self) -> int:
+        # nrcheck: unshared — single int load, GIL-atomic; approximate
         return self._value
 
     def _reset(self) -> None:
@@ -65,7 +68,8 @@ class Counter:
             self._value = 0
 
     def _snapshot(self):
-        return self._value
+        with self._lock:  # scrape path: exact, not approximate
+            return self._value
 
 
 class Gauge:
@@ -111,7 +115,7 @@ class Histogram:
                  buckets=DURATION_BUCKETS_S):
         self.name = name
         self._reg = reg
-        self._lock = threading.Lock()
+        self._lock = make_lock("Histogram._lock")
         self._bounds = tuple(float(b) for b in buckets)
         if list(self._bounds) != sorted(set(self._bounds)):
             raise ValueError(f"{name}: bucket bounds must strictly ascend")
@@ -137,32 +141,42 @@ class Histogram:
 
     @property
     def count(self) -> int:
+        # nrcheck: unshared — single int load, GIL-atomic; approximate
         return self._count
 
     @property
     def sum(self) -> float:
+        # nrcheck: unshared — single float load, GIL-atomic; approximate
         return self._sum
 
     def percentile(self, p: float) -> float:
-        """Estimate the p-quantile (p in [0, 1]) from the bucket counts."""
+        """Estimate the p-quantile (p in [0, 1]) from the bucket counts.
+
+        Reads are deliberately lock-free: percentile() is an
+        approximate estimator and may tear against a concurrent
+        `observe`; the exact path is `_snapshot`, which holds the
+        lock around the same arithmetic (`_snapshot_locked`).
+        """
         if not 0.0 <= p <= 1.0:
             raise ValueError(f"percentile {p} outside [0, 1]")
-        if self._count == 0:
+        if self._count == 0:  # nrcheck: unshared — approximate read
             return 0.0
-        rank = p * self._count
+        rank = p * self._count  # nrcheck: unshared — approximate read
         cum = 0
+        # nrcheck: unshared — approximate read
         for i, c in enumerate(self._counts):
             if c == 0:
                 continue
             if cum + c >= rank:
                 lo = self._bounds[i - 1] if i > 0 else 0.0
                 hi = (self._bounds[i] if i < len(self._bounds)
-                      else self._max)
+                      else self._max)  # nrcheck: unshared — approx read
                 frac = (rank - cum) / c
                 est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+                # nrcheck: unshared — approximate read
                 return max(self._min, min(self._max, est))
             cum += c
-        return self._max
+        return self._max  # nrcheck: unshared — approximate read
 
     def _reset(self) -> None:
         with self._lock:
@@ -173,8 +187,17 @@ class Histogram:
             self._max = float("-inf")
 
     def _snapshot(self):
-        if self._count == 0:
-            return {"count": 0, "sum": 0.0}
+        # scrape path: hold the lock so count/sum/percentiles agree
+        # with each other (the lock-free properties may tear; an
+        # exported snapshot must not)
+        with self._lock:
+            if self._count == 0:
+                return {"count": 0, "sum": 0.0}
+            return self._snapshot_locked()
+
+    # the lock is held (`_snapshot`); percentile() reads are exact here
+    # guarded-by: _lock
+    def _snapshot_locked(self):
         return {
             "count": self._count,
             "sum": self._sum,
@@ -190,7 +213,9 @@ class MetricsRegistry:
     """Named instruments behind one process-wide enable flag."""
 
     def __init__(self, enabled: bool = False):
-        self._lock = threading.Lock()
+        # nrcheck: lock-order MetricsRegistry._lock -> Counter._lock — reset() zeroes instruments under the registry lock
+        # nrcheck: lock-order MetricsRegistry._lock -> Histogram._lock — reset() zeroes instruments under the registry lock
+        self._lock = make_lock("MetricsRegistry._lock")
         self._metrics: dict[str, Counter | Gauge | Histogram] = {}
         self.enabled = bool(enabled)
 

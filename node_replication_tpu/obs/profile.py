@@ -60,6 +60,8 @@ from __future__ import annotations
 import os
 import sys
 import threading
+
+from node_replication_tpu.analysis.locks import make_lock
 import time
 
 from node_replication_tpu.obs.metrics import get_registry
@@ -237,7 +239,7 @@ class SamplingProfiler:
         self.hz = float(hz)
         self.max_stacks = int(max_stacks)
         self.max_depth = int(max_depth)
-        self._lock = threading.Lock()
+        self._lock = make_lock("SamplingProfiler._lock")
         self._stacks: dict[tuple, _StackRec] = {}
         self._roles: dict[str, dict] = {}
         self._role_threads: dict[str, set] = {}
@@ -261,6 +263,7 @@ class SamplingProfiler:
 
     @property
     def running(self) -> bool:
+        # nrcheck: unshared — lock-free poll; one reference load
         t = self._thread
         return t is not None and t.is_alive()
 

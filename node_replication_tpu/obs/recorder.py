@@ -55,6 +55,8 @@ import contextlib
 import json
 import os
 import threading
+
+from node_replication_tpu.analysis.locks import make_lock
 import time
 from typing import Any
 
@@ -94,7 +96,7 @@ _NULL_SPAN = _NullSpan()
 
 class Tracer:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("Tracer._lock")
         self._fh = None
         self._buffer: "collections.deque[dict] | list[dict] | None" = None
         #: total events ever emitted to the current sink — with

@@ -205,7 +205,6 @@ class FusedEngineHost:
         from node_replication_tpu.utils.trace import get_tracer
 
         n_launch = self.launches(window)
-        # nrlint: disable=obs-in-traced — host side of the jit boundary
         if self.tier == "mesh_fused":
             _corelog._m_engine_mesh_fused.inc()
         else:

@@ -228,7 +228,6 @@ def make_shmap_exec(
     nshards = mesh.shape[axis]
     if R % nshards:
         raise ValueError(f"R={R} not divisible by {nshards} shards")
-    # nrlint: disable=obs-in-traced — per-build tier counter by design
     _m_engine_shmap.inc()
 
     def local(log, states_l, *mask):

@@ -50,6 +50,8 @@ import dataclasses
 import logging
 import threading
 
+from node_replication_tpu.analysis.locks import make_condition
+
 import numpy as np
 
 from node_replication_tpu.durable.recovery import recover_fleet
@@ -120,7 +122,7 @@ class Follower:
             directory, dispatch, policy="batch", attach=True,
             nr_kwargs=nr_kwargs,
         )
-        self._cond = threading.Condition()
+        self._cond = make_condition("Follower._cond")
         self._applied = int(np.asarray(self.nr.log.tail))
         #: highest epoch among APPLIED records (the zombie fence
         #: floor) — starts at 0, NOT feed.epoch(): a follower seeded
@@ -249,7 +251,10 @@ class Follower:
         the number of records applied. `drain=True` (the promotion
         path) ignores the stop flag so the backlog flushes whole."""
         fault_hook("repl-apply", -1, self)
-        records = self._feed.poll(self._applied)
+        # _applied/epoch reads in the apply path below: the apply
+        # thread is their only writer after __init__ (promote() joins
+        # the thread first), so lock-free reads here cannot be stale
+        records = self._feed.poll(self._applied)  # nrcheck: unshared
         applied = 0
         tail = (
             records[-1].pos + records[-1].count if records else 0
@@ -261,6 +266,7 @@ class Follower:
                 if self._stop and not drain:
                     break
         if records:
+            # nrcheck: unshared — apply thread, own write
             self._g_lag.set(max(0, tail - self._applied))
         return applied
 
@@ -269,14 +275,15 @@ class Follower:
         True when it advanced the applied position. `feed_tail` (the
         poll batch's end position) feeds the per-record lag stamp on
         the `repl-apply` event."""
-        expected = self._applied
+        expected = self._applied  # nrcheck: unshared — apply-only write
         end = rec.pos + rec.count
-        if rec.epoch < self.epoch:
+        if rec.epoch < self.epoch:  # nrcheck: unshared — apply-only write
             # zombie fence: a record stamped by a superseded primary
             # arriving after a newer epoch was applied — reject, the
             # new primary's history owns these positions
             self._m_fenced.inc()
             get_tracer().emit("repl-fenced-record", pos=rec.pos,
+                              # nrcheck: unshared — apply thread
                               epoch=rec.epoch, current=self.epoch)
             return False
         if end <= expected:
@@ -304,7 +311,9 @@ class Follower:
         # narrated, an unsampled one never is, on every follower alike
         if tracer.enabled and pos_sampled(rec.pos):
             tracer.emit("repl-apply", pos=rec.pos, n=len(ops),
+                        # nrcheck: unshared — apply thread, own write
                         epoch=rec.epoch, applied=self._applied,
+                        # nrcheck: unshared — apply thread, own write
                         lag=max(0, feed_tail - self._applied),
                         name=self.name)
         return True
@@ -317,9 +326,11 @@ class Follower:
             self._error = exc
             self._cond.notify_all()
         self._m_errors.inc()
+        # nrcheck: unshared — apply thread, own write
         get_tracer().emit("repl-apply-error", applied=self._applied,
                           cause=type(exc).__name__)
         logger.exception("follower %s apply failed at %d", self.name,
+                         # nrcheck: unshared — apply thread, own write
                          self._applied)
         if self.health is not None:
             self.health.report_worker_exception(self.health_rid, exc)
@@ -347,10 +358,12 @@ class Follower:
 
     @property
     def error(self) -> BaseException | None:
+        # nrcheck: unshared — lock-free poll; one reference load
         return self._error
 
     @property
     def promoted(self) -> bool:
+        # nrcheck: unshared — lock-free poll; one bool load
         return self._promoted
 
     def wait_applied(self, pos: int,
@@ -469,9 +482,14 @@ class Follower:
                 f"follower {self.name}: apply thread still alive "
                 f"after stop; draining now could double-apply"
             )
+        # epoch/_applied reads below are safe lock-free: the apply
+        # thread (their only other writer) was stopped and verified
+        # dead above, so promotion is now the sole accessor
         new_epoch = self._feed.fence(
+            # nrcheck: unshared — apply thread joined above
             max(self.epoch, self._feed.epoch()) + 1
         )
+        # nrcheck: unshared — apply thread joined above
         with span("repl-promote-drain", applied=self._applied):
             drained = self._apply_once(drain=True)
             # keep draining until a poll finds nothing new: the feed
@@ -496,11 +514,13 @@ class Follower:
             t_dead = clock.now() + float(drain_timeout_s)
             while True:
                 tail = int(self._feed.tail_pos())
+                # nrcheck: unshared — apply thread joined above
                 if self._applied >= tail:
                     break
                 if clock.now() >= t_dead:
                     raise RuntimeError(
                         f"follower {self.name}: promotion drain "
+                        # nrcheck: unshared — apply thread joined above
                         f"stalled at {self._applied} below the "
                         f"fenced feed tail {tail} (transport "
                         f"degraded?) — refusing to serve a "

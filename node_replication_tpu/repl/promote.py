@@ -36,6 +36,8 @@ import dataclasses
 import logging
 import threading
 
+from node_replication_tpu.analysis.locks import make_lock
+
 from node_replication_tpu.fault.health import (
     HEALTHY,
     QUARANTINED,
@@ -98,7 +100,8 @@ class PromotionManager:
         )
         self.health_rid = int(health_rid)
 
-        self._lock = threading.Lock()
+        # nrcheck: lock-order PromotionManager._lock -> HealthTracker._lock — election consults replica health under the manager lock
+        self._lock = make_lock("PromotionManager._lock")
         self._last_hb: str | None = None
         self._last_change = get_clock().now()
         # silence counts only once a primary has been OBSERVED: a

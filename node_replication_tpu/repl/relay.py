@@ -51,6 +51,11 @@ import logging
 import os
 import threading
 
+from node_replication_tpu.analysis.locks import (
+    make_condition,
+    make_lock,
+)
+
 from node_replication_tpu.fault.inject import fault_hook
 from node_replication_tpu.obs.metrics import get_registry
 from node_replication_tpu.repl.feed import (
@@ -110,11 +115,11 @@ class RelayNode:
         #: follower's apply floor: a relay booted behind a promotion
         #: must still forward the older epochs' history below it)
         self.epoch = 0
-        self._cond = threading.Condition()
+        self._cond = make_condition("RelayNode._cond")
         self._error: BaseException | None = None
         self._stop = False
         self._last_hb: str | None = None
-        self._snap_lock = threading.Lock()
+        self._snap_lock = make_lock("RelayNode._snap_lock")
 
         reg = get_registry()
         self._m_forwarded = reg.counter("repl.relay.forwarded_records")
@@ -213,24 +218,33 @@ class RelayNode:
         returns records forwarded. Single-driver (the pump thread, or
         tests calling it directly with `auto_start=False`)."""
         fault_hook("relay-pump", -1, self)
-        records = self.upstream.poll(self._cursor)
+        # _cursor reads below: the pump is _cursor's only writer, and
+        # this method is single-driver (see docstring) — a lock-free
+        # read in the writing thread cannot be stale
+        records = self.upstream.poll(self._cursor)  # nrcheck: unshared
         forwarded = 0
         tracer = get_tracer()
         for rec in records:
             end = rec.pos + rec.count
-            if end <= self._cursor:
+            if end <= self._cursor:  # nrcheck: unshared — pump-only write
                 self._m_dups.inc()
                 continue
-            if rec.pos > self._cursor:
-                raise FeedGapError(self._cursor, rec.pos)
-            if rec.epoch < self.epoch:
+            if rec.pos > self._cursor:  # nrcheck: unshared — pump-only write
+                raise FeedGapError(self._cursor, rec.pos)  # nrcheck: unshared
+            with self._cond:
+                # snapshot the forwarding floor under the lock: a
+                # server-thread fence (`_propagate_fence`) can raise
+                # it concurrently, and a stale read here would
+                # forward a record below the new floor
+                epoch_floor = self.epoch
+            if rec.epoch < epoch_floor:
                 # zombie record below the forwarding floor: drop it
                 # and advance PAST it — these positions belong to a
                 # superseded history no consumer may ever see, and
                 # re-polling them forever would wedge the pump
                 self._m_fenced.inc()
                 tracer.emit("relay-fenced", pos=rec.pos,
-                            epoch=rec.epoch, current=self.epoch)
+                            epoch=rec.epoch, current=epoch_floor)
                 with self._cond:
                     self._cursor = end
                 continue
@@ -291,9 +305,11 @@ class RelayNode:
             self._cond.notify_all()
         self._m_errors.inc()
         get_tracer().emit("relay-error", name=self.name,
+                          # nrcheck: unshared — pump thread, own write
                           cursor=self._cursor,
                           cause=type(exc).__name__)
         logger.exception("relay %s pump failed at cursor %d",
+                         # nrcheck: unshared — pump thread, own write
                          self.name, self._cursor)
         if self.health is not None:
             self.health.report_worker_exception(self.health_rid, exc)
@@ -360,6 +376,7 @@ class RelayNode:
 
     @property
     def error(self) -> BaseException | None:
+        # nrcheck: unshared — lock-free poll; one reference load
         return self._error
 
     def cursor(self) -> int:

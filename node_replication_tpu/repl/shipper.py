@@ -37,6 +37,11 @@ from __future__ import annotations
 import logging
 import threading
 
+from node_replication_tpu.analysis.locks import (
+    make_condition,
+    make_lock,
+)
+
 from node_replication_tpu.fault.inject import fault_hook
 from node_replication_tpu.obs.metrics import get_registry
 from node_replication_tpu.utils.clock import get_clock
@@ -53,7 +58,7 @@ logger = logging.getLogger("node_replication_tpu")
 SHIP_PIN = "ship"
 
 _pin_seq = 0
-_pin_seq_lock = threading.Lock()
+_pin_seq_lock = make_lock("shipper._pin_seq_lock")
 
 
 def _next_pin_name() -> str:
@@ -123,7 +128,7 @@ class ReplicationShipper:
             )
         wal.set_pin(self.pin_name, self._cursor)
 
-        self._cond = threading.Condition()
+        self._cond = make_condition("ReplicationShipper._cond")
         self._published = self._cursor
         self._error: BaseException | None = None
         self._stop = False
@@ -185,6 +190,7 @@ class ReplicationShipper:
         fault_hook("ship", -1, self)
         self._maybe_heartbeat()
         target = self._wal.durable_tail
+        # nrcheck: unshared — ship thread is _cursor's only writer
         cur = self._cursor
         if cur >= target:
             return
@@ -225,6 +231,7 @@ class ReplicationShipper:
         self._hb_due = now + self.heartbeat_interval_s
         self._hb_seq += 1
         self._feed.write_heartbeat(
+            # nrcheck: unshared — ship thread, own write
             f"{self.epoch} {self._hb_seq} {self._cursor}"
         )
 
@@ -238,9 +245,11 @@ class ReplicationShipper:
             self._cond.notify_all()
         self._m_errors.inc()
         get_tracer().emit("repl-ship-error", epoch=self.epoch,
+                          # nrcheck: unshared — ship thread, own write
                           cursor=self._cursor,
                           cause=type(exc).__name__)
         logger.exception("replication shipper failed at cursor %d",
+                         # nrcheck: unshared — ship thread, own write
                          self._cursor)
         if self.health is not None:
             self.health.report_worker_exception(self.health_rid, exc)
@@ -283,14 +292,17 @@ class ReplicationShipper:
     @property
     def cursor(self) -> int:
         """Next unshipped logical position."""
+        # nrcheck: unshared — lock-free poll; one int load
         return self._cursor
 
     @property
     def error(self) -> BaseException | None:
+        # nrcheck: unshared — lock-free poll; one reference load
         return self._error
 
     def lag(self) -> int:
         """Positions fsynced on the primary but not yet shipped."""
+        # nrcheck: unshared — lock-free poll; approximate by design
         return max(0, self._wal.durable_tail - self._cursor)
 
     def install_backpressure(self, frontend, low: int = 512,

@@ -70,6 +70,11 @@ import os
 import socket
 import struct
 import threading
+
+from node_replication_tpu.analysis.locks import (
+    make_condition,
+    make_lock,
+)
 import zlib
 
 import numpy as np
@@ -267,7 +272,7 @@ class FeedServer:
     """
 
     _seq = 0
-    _seq_lock = threading.Lock()
+    _seq_lock = make_lock("FeedServer._seq_lock")
 
     def __init__(
         self,
@@ -308,8 +313,8 @@ class FeedServer:
         self._sock.settimeout(self.accept_timeout_s)
         self.address: tuple[str, int] = self._sock.getsockname()[:2]
 
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = make_lock("FeedServer._lock")
+        self._cond = make_condition("FeedServer._lock", lock=self._lock)
         self._stop = False
         self._conns: dict[int, socket.socket] = {}
         #: conn id -> highest poll cursor the client has CONFIRMED (a
@@ -319,7 +324,7 @@ class FeedServer:
         self._conn_seq = 0
         self._threads: list[threading.Thread] = []
         self._snap_seq = 0
-        self._fence_lock = threading.Lock()
+        self._fence_lock = make_lock("FeedServer._fence_lock")
         self._last_fence: tuple[int, bytes] | None = None
 
         reg = get_registry()
@@ -728,7 +733,8 @@ class SocketFeed:
         self.max_records = int(max_records)
         self.name = name
 
-        self._lock = threading.Lock()
+        # nrcheck: lock-order SocketFeed._lock -> Counter._lock — RPC failure/retry counters bump under the transport lock
+        self._lock = make_lock("SocketFeed._lock")
         self._sock: socket.socket | None = None
         # last connected observations: the degraded-mode answers
         self._tail = 0

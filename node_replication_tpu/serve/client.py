@@ -59,6 +59,8 @@ import dataclasses
 import random
 import threading
 
+from node_replication_tpu.analysis.locks import make_lock
+
 from node_replication_tpu.obs.metrics import get_registry
 from node_replication_tpu.serve.errors import (
     CircuitOpen,
@@ -139,7 +141,8 @@ class CircuitBreaker:
             raise ValueError("cooldown_s must be > 0")
         self.failure_threshold = int(failure_threshold)
         self.cooldown_s = float(cooldown_s)
-        self._lock = threading.Lock()
+        # nrcheck: lock-order CircuitBreaker._lock -> Counter._lock — trip/recover counters bump under the breaker lock
+        self._lock = make_lock("CircuitBreaker._lock")
         self._state = CLOSED
         self._failures = 0
         self._open_until = 0.0

@@ -78,6 +78,11 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+
+from node_replication_tpu.analysis.locks import (
+    make_condition,
+    make_lock,
+)
 from collections import deque
 from typing import Callable, Sequence
 
@@ -332,7 +337,7 @@ class _PipelineChannel:
     __slots__ = ("_lock", "_slot", "_busy", "_closed", "_dead")
 
     def __init__(self):
-        self._lock = threading.Condition()
+        self._lock = make_condition("_PipelineChannel._lock")
         self._slot: _Staged | None = None
         self._busy = False
         self._closed = False
@@ -437,7 +442,7 @@ class _SubmissionQueue:
                  "_reg", "_m_wait", "_m_linger")
 
     def __init__(self, depth: int):
-        self._lock = threading.Condition()
+        self._lock = make_condition("_SubmissionQueue._lock")
         # queue-wait accounting (host-budget input): how long the
         # worker sat on the condition before the first op arrived, and
         # how long it lingered for the batch to fill. One `enabled`
@@ -747,8 +752,13 @@ class ServeFrontend:
                 ))
         # guards _queues/_workers/_read_tokens/_closed topology changes
         # (grow, close); the hot submit path reads the dicts lock-free
-        # (GIL-atomic lookups; workers are keyed once at creation)
-        self._lock = threading.Lock()
+        # (GIL-atomic lookups; workers are keyed once at creation).
+        # Declared nestings the analyzer cannot type (`self._nr` is a
+        # duck-typed wrapper; queues live in a dict):
+        # nrcheck: lock-order ServeFrontend._lock -> NodeReplicated._lock — close/grow/stats call into the wrapper under the frontend lock
+        # nrcheck: lock-order ServeFrontend._lock -> MultiLogReplicated._lock — same nesting through the CNR wrapper
+        # nrcheck: lock-order ServeFrontend._lock -> _SubmissionQueue._lock — queue close/drain runs under the frontend lock
+        self._lock = make_lock("ServeFrontend._lock")
         self._closed = False
         self._started = False
         self._queues: dict[int, _SubmissionQueue] = {}
@@ -949,13 +959,13 @@ class ServeFrontend:
         q, t, cpl, chan, token, gauge = built
         # both callers (the constructor and grow()) hold _lock, which
         # is non-reentrant — re-acquiring here would deadlock
-        self._queues[rid] = q  # nrlint: disable=lock-discipline
-        self._workers[rid] = t  # nrlint: disable=lock-discipline
+        self._queues[rid] = q  # nrlint: disable=lock-discipline — caller holds _lock
+        self._workers[rid] = t  # nrlint: disable=lock-discipline — caller holds _lock
         if cpl is not None:
-            self._completers[rid] = cpl  # nrlint: disable=lock-discipline
-            self._channels[rid] = chan  # nrlint: disable=lock-discipline
-        self._read_tokens[rid] = token  # nrlint: disable=lock-discipline
-        self._depth_gauges[rid] = gauge  # nrlint: disable=lock-discipline
+            self._completers[rid] = cpl  # nrlint: disable=lock-discipline — caller holds _lock
+            self._channels[rid] = chan  # nrlint: disable=lock-discipline — caller holds _lock
+        self._read_tokens[rid] = token  # nrlint: disable=lock-discipline — caller holds _lock
+        self._depth_gauges[rid] = gauge  # nrlint: disable=lock-discipline — caller holds _lock
 
     def start(self) -> None:
         """Start every not-yet-running worker (idempotent)."""
@@ -1254,11 +1264,15 @@ class ServeFrontend:
                 f"BULK=2)"
             )
         # closed wins over failed: a closed frontend is PERMANENT and
-        # must not hand retry loops a retryable ReplicaFailed
-        if not self._closed and rid in self._failed:  # GIL-atomic reads
+        # must not hand retry loops a retryable ReplicaFailed.
+        # Admission fast path: GIL-atomic flag/dict reads — a racing
+        # failover is caught again below (`q.offer` under its lock)
+        # nrcheck: unshared — GIL-atomic reads; re-checked under lock
+        if not self._closed and rid in self._failed:
+            # nrcheck: unshared — GIL-atomic dict read
             raise ReplicaFailed(rid, self._failed.get(rid),
                                 maybe_executed=False)
-        q = self._queues.get(rid)
+        q = self._queues.get(rid)  # nrcheck: unshared — GIL-atomic read
         if q is None:
             raise ValueError(f"replica {rid} is not served "
                              f"(have {self.rids})")
@@ -1278,8 +1292,10 @@ class ServeFrontend:
             # a per-replica queue closed while the frontend is open can
             # only mean this replica failed (or is mid-restart): that
             # is the retryable signal, not a permanent closure
+            # nrcheck: unshared — GIL-atomic flag read
             if not self._closed:
                 raise ReplicaFailed(
+                    # nrcheck: unshared — GIL-atomic dict read
                     rid, self._failed.get(rid), maybe_executed=False
                 ) from None
             raise
@@ -1391,6 +1407,7 @@ class ServeFrontend:
         recorded (`governor.stats()['max_brownout_lag']`). An
         explicit `min_pos` (read-your-writes) always takes the synced
         path — a client that asked for a bound gets that bound."""
+        # nrcheck: unshared — GIL-atomic dict read; read fast path
         token = self._read_tokens.get(rid)
         if token is None:
             raise ValueError(f"replica {rid} is not served "
@@ -1443,6 +1460,9 @@ class ServeFrontend:
             retired_prio = dict(self._retired_prio)
             rehomed = self._rehomed
             failed = sorted(self._failed)
+            # `_record_device` writes this map under the lock from
+            # worker threads: snapshot it here, not mid-iteration
+            dev_map = dict(self.device_of_rid)
         per = {rid: q.stats() for rid, q in queues}
         agg = {
             k: sum(s[k] for s in per.values())
@@ -1463,14 +1483,14 @@ class ServeFrontend:
         agg["replicas"] = per
         if self.governor is not None:
             agg["overload"] = self.governor.stats()
-        if self.device_of_rid:
+        if dev_map:
             per_dev: dict[str, int] = {}
-            for dev in self.device_of_rid.values():
+            for dev in dev_map.values():
                 per_dev[dev] = per_dev.get(dev, 0) + 1
             agg["mesh"] = {
                 "devices": len(per_dev),
                 "replicas_per_device": per_dev,
-                "device_of_rid": dict(sorted(self.device_of_rid.items())),
+                "device_of_rid": dict(sorted(dev_map.items())),
             }
         return agg
 
@@ -1691,6 +1711,9 @@ class ServeFrontend:
         self._m_completed.inc(len(live))
         self._m_batch_size.observe(len(live))
         self._m_batch_dur.observe(dur)
+        # the map is written under _lock at replica creation, before
+        # this worker exists, so the lock-free lookup cannot race it
+        # nrcheck: unshared — GIL-atomic dict read
         self._depth_gauges[rid].set(depth)
         tracer = get_tracer()
         if tracer.enabled:

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import logging
 import threading
+
+from node_replication_tpu.analysis.locks import make_condition
 from typing import Any, Callable
 
 from node_replication_tpu.utils.clock import get_clock
@@ -38,7 +40,7 @@ class ServeFuture:
     )
 
     def __init__(self, rid: int, deadline: float | None = None):
-        self._cond = threading.Condition()
+        self._cond = make_condition("ServeFuture._cond")
         self._done = False
         self._value: Any = None
         self._exc: BaseException | None = None

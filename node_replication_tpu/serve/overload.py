@@ -57,6 +57,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+
+from node_replication_tpu.analysis.locks import make_lock
 from typing import Callable
 
 from node_replication_tpu.obs.metrics import get_registry
@@ -176,7 +178,7 @@ class OverloadGovernor:
         #: so `stats()` (and the bench CSVs) can attribute a run's
         #: limits to its overlap mode.
         self.pipeline_depth = int(pipeline_depth)
-        self._lock = threading.Lock()
+        self._lock = make_lock("OverloadGovernor._lock")
         self._limits: dict[int, float] = {}
         self._gauges: dict[int, object] = {}
         self._sources: list[LagSource] = []
@@ -223,11 +225,13 @@ class OverloadGovernor:
     def limit(self, rid: int) -> int:
         """Current admission bound for replica `rid` (falls back to
         the static depth for a replica never registered)."""
-        lim = self._limits.get(rid)  # GIL-atomic dict read
+        # nrcheck: unshared — GIL-atomic dict read; admission hot path
+        lim = self._limits.get(rid)
         return self._depth if lim is None else int(lim)
 
     def brownout(self) -> bool:
-        return self._brownout  # GIL-atomic flag read
+        # nrcheck: unshared — GIL-atomic flag read; admission hot path
+        return self._brownout
 
     # ------------------------------------------------------ control loop
 

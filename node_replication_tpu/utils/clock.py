@@ -37,6 +37,8 @@ from __future__ import annotations
 
 import contextlib
 import threading
+
+from node_replication_tpu.analysis.locks import make_condition
 import time
 
 
@@ -96,7 +98,7 @@ class SimClock(Clock):
     """
 
     def __init__(self, start: float = 0.0, auto_advance: bool = True):
-        self._cond = threading.Condition()
+        self._cond = make_condition("SimClock._cond")
         self._now = float(start)
         self.auto_advance = bool(auto_advance)
         # timed condition waiters: list of [deadline, cond] entries
@@ -123,6 +125,10 @@ class SimClock(Clock):
     def wait(self, cond: threading.Condition,
              timeout: float | None = None) -> bool:
         if timeout is None:
+            # the predicate loop lives at the CALLER (the Clock.wait
+            # contract mirrors Condition.wait); spurious wakeups are
+            # re-checked there
+            # nrlint: disable=condition-wait-without-predicate-loop
             cond.wait()
             return True
         with self._cond:
@@ -132,7 +138,9 @@ class SimClock(Clock):
             self._waiters.append(entry)
         try:
             # block with no real timeout: a real notify or the clock
-            # crossing `deadline` (advance notifies `cond`) wakes us
+            # crossing `deadline` (advance notifies `cond`) wakes us;
+            # the caller's predicate loop absorbs spurious wakeups
+            # nrlint: disable=condition-wait-without-predicate-loop
             cond.wait()
         finally:
             with self._cond:

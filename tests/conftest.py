@@ -22,3 +22,11 @@ if "--xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 budgeted run (-m 'not slow'); "
+        "still runs in the unfiltered CI test job",
+    )

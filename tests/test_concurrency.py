@@ -1,0 +1,614 @@
+"""nrcheck: whole-program lock-discipline analysis + runtime checker
+(ISSUE 17).
+
+Static half (`analysis/concurrency.py`): fixture modules exercise the
+guarded-by inference (true positive, true negative, both annotation
+escape hatches), the global lock-order graph (direct nesting,
+interprocedural nesting, declared edges, cycle reporting), and the two
+satellite rules. Fixtures follow `test_analysis.py`'s convention:
+self-contained snippets written to tmp_path, analyzed purely
+syntactically.
+
+Runtime half (`analysis/locks.py`): the instrumented factory under a
+private `fresh_state()` — single-thread order inversion, a LIVE
+two-thread deadlock interleaving that `LockOrderError` catches before
+either thread hangs, reentrancy, trylock probes, Condition
+integration, the passthrough contract, and the lockgraph dump that
+`--check-dynamic` gates against the static graph.
+"""
+
+import json
+import textwrap
+import threading
+import time
+
+import pytest
+
+from node_replication_tpu.analysis import concurrency
+from node_replication_tpu.analysis import locks as locks_mod
+from node_replication_tpu.analysis.lint import (
+    audit_suppressions,
+    build_project,
+    main,
+    run_lint,
+)
+from node_replication_tpu.analysis.locks import (
+    LockOrderError,
+    _CheckedLock,
+    _CheckedRLock,
+    dump_lockgraph,
+    fresh_state,
+    make_condition,
+    make_lock,
+    make_rlock,
+)
+
+
+def lint_src(tmp_path, source, name="snippet.py", select=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    diags, errors = run_lint([str(p)], select=select)
+    assert not errors, errors
+    return diags
+
+
+def firing(diags, rule_id):
+    return [d for d in diags if d.rule_id == rule_id and not d.suppressed]
+
+
+def analyze_src(tmp_path, source, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    modules, project, errors = build_project([str(p)])
+    assert not errors, errors
+    return concurrency.analyze(project)
+
+
+# a thread-shared fixture class: spawns a role-named worker that
+# stores `_v` under `_lock`, so `_v` is inferred guarded-by `_lock`
+SHARED_CLASS = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._v = 0
+            self._t = threading.Thread(
+                target=self._run, name="serve-worker-0"
+            )
+            self._t.start()
+
+        def _run(self):
+            with self._lock:
+                self._v += 1
+"""
+
+
+class TestRoleOracle:
+    def test_prefixes_mirror_obs_profile(self):
+        # the analysis ships its own copy (analysis must not import
+        # runtime modules); this pin keeps the two tables in lockstep
+        from node_replication_tpu.obs import profile
+
+        assert set(concurrency.ROLE_PREFIXES) == set(
+            profile._ROLE_PREFIXES
+        )
+
+
+class TestGuardedByInference:
+    def test_unlocked_read_in_shared_class_fires(self, tmp_path):
+        diags = lint_src(tmp_path, SHARED_CLASS + """
+            def peek(self):
+                return self._v
+        """ .replace("\n    ", "\n"))
+        hits = firing(diags, "nrcheck-guarded-by")
+        assert len(hits) == 1
+        assert "Box._v" in hits[0].message
+        assert "Box._lock" in hits[0].message
+
+    def test_locked_read_clean(self, tmp_path):
+        diags = lint_src(tmp_path, SHARED_CLASS + """
+            def peek(self):
+                with self._lock:
+                    return self._v
+        """ .replace("\n    ", "\n"))
+        assert not firing(diags, "nrcheck-guarded-by")
+
+    def test_unshared_annotation_silences(self, tmp_path):
+        diags = lint_src(tmp_path, SHARED_CLASS + """
+            def peek(self):
+                # nrcheck: unshared — lock-free poll, fixture
+                return self._v
+        """ .replace("\n    ", "\n"))
+        assert not firing(diags, "nrcheck-guarded-by")
+
+    def test_guarded_by_method_annotation_silences(self, tmp_path):
+        # caller-holds-the-lock contract: the whole method is a region
+        diags = lint_src(tmp_path, SHARED_CLASS + """
+            # guarded-by: _lock
+            def peek(self):
+                return self._v
+        """ .replace("\n    ", "\n"))
+        assert not firing(diags, "nrcheck-guarded-by")
+
+    def test_unshared_class_not_flagged(self, tmp_path):
+        # same shape, but nothing spawns a thread: single-threaded
+        # callers may read lock-free without a diagnostic
+        diags = lint_src(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._v = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._v += 1
+
+                def peek(self):
+                    return self._v
+        """)
+        assert not firing(diags, "nrcheck-guarded-by")
+
+
+class TestLockOrder:
+    def test_direct_inversion_cycle_fires(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def ab():
+                with lock_a:
+                    with lock_b:
+                        pass
+
+            def ba():
+                with lock_b:
+                    with lock_a:
+                        pass
+        """)
+        assert firing(diags, "nrcheck-lock-order")
+
+    def test_consistent_order_clean(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def ab():
+                with lock_a:
+                    with lock_b:
+                        pass
+
+            def ab_again():
+                with lock_a:
+                    with lock_b:
+                        pass
+        """)
+        assert not firing(diags, "nrcheck-lock-order")
+
+    def test_interprocedural_cycle_fires(self, tmp_path):
+        # outer holds A and reaches B only through a call: the edge
+        # comes from the callee's transitive acquire summary
+        diags = lint_src(tmp_path, """
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def outer():
+                with lock_a:
+                    inner()
+
+            def inner():
+                with lock_b:
+                    pass
+
+            def rev():
+                with lock_b:
+                    with lock_a:
+                        pass
+        """)
+        assert firing(diags, "nrcheck-lock-order")
+
+    def test_declared_edge_enters_graph(self, tmp_path):
+        # a `# nrcheck: lock-order` declaration is a real edge: with
+        # the reverse nesting in code, the cycle is reported
+        diags = lint_src(tmp_path, """
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            # nrcheck: lock-order snippet.lock_a -> snippet.lock_b — fixture
+            def rev():
+                with lock_b:
+                    with lock_a:
+                        pass
+        """)
+        assert firing(diags, "nrcheck-lock-order")
+
+    def test_static_edge_list(self, tmp_path):
+        analysis = analyze_src(tmp_path, """
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def ab():
+                with lock_a:
+                    with lock_b:
+                        pass
+        """)
+        assert ["snippet.lock_a", "snippet.lock_b"] in analysis.edge_list()
+        assert not analysis.cycles
+
+    def test_check_dynamic_subgraph(self, tmp_path):
+        analysis = analyze_src(tmp_path, """
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def ab():
+                with lock_a:
+                    with lock_b:
+                        pass
+        """)
+        assert analysis.check_dynamic(
+            [["snippet.lock_a", "snippet.lock_b"]]
+        ) == []
+        rogue = analysis.check_dynamic(
+            [["snippet.lock_b", "snippet.lock_a"]]
+        )
+        assert len(rogue) == 1
+
+
+class TestAnnotationDiags:
+    def test_malformed_nrcheck_comment_warns(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import threading
+
+            # nrcheck: unshareable
+            x = 1
+        """)
+        assert firing(diags, "nrcheck-annotation")
+
+    def test_factory_name_drift_warns(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            from node_replication_tpu.analysis.locks import make_lock
+
+            class Box:
+                def __init__(self):
+                    self._lock = make_lock("Wrong._lock")
+        """)
+        hits = firing(diags, "nrcheck-annotation")
+        assert len(hits) == 1
+        assert "Box._lock" in hits[0].message
+
+    def test_factory_name_match_clean(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            from node_replication_tpu.analysis.locks import make_lock
+
+            class Box:
+                def __init__(self):
+                    self._lock = make_lock("Box._lock")
+        """)
+        assert not firing(diags, "nrcheck-annotation")
+
+
+class TestConditionWaitRule:
+    RULE = "condition-wait-without-predicate-loop"
+
+    def test_bare_wait_fires(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def bad(self):
+                    with self._cond:
+                        self._cond.wait()
+        """)
+        assert len(firing(diags, self.RULE)) == 1
+
+    def test_wait_in_predicate_loop_clean(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def good(self):
+                    with self._cond:
+                        while not self.ready:
+                            self._cond.wait()
+        """)
+        assert not firing(diags, self.RULE)
+
+    def test_timed_wait_clean(self, tmp_path):
+        # a timed wait is a poll: the caller re-checks by construction
+        diags = lint_src(tmp_path, """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def poll(self):
+                    with self._cond:
+                        self._cond.wait(0.05)
+        """)
+        assert not firing(diags, self.RULE)
+
+
+class TestLockHeldAcrossBlockingCall:
+    RULE = "lock-held-across-blocking-call"
+
+    def test_sendall_under_lock_fires(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import threading
+
+            class S:
+                def __init__(self, sock):
+                    self._lock = threading.Lock()
+                    self.sock = sock
+
+                def bad(self, data):
+                    with self._lock:
+                        self.sock.sendall(data)
+        """)
+        assert len(firing(diags, self.RULE)) == 1
+
+    def test_sendall_outside_lock_clean(self, tmp_path):
+        diags = lint_src(tmp_path, """
+            import threading
+
+            class S:
+                def __init__(self, sock):
+                    self._lock = threading.Lock()
+                    self.sock = sock
+                    self.buf = b""
+
+                def good(self, data):
+                    with self._lock:
+                        self.buf = bytes(data)
+                    self.sock.sendall(self.buf)
+        """)
+        assert not firing(diags, self.RULE)
+
+
+# ---------------------------------------------------------------- runtime
+
+
+class TestCheckedLocks:
+    def test_single_thread_inversion_raises(self):
+        with fresh_state():
+            a = _CheckedLock("A")
+            b = _CheckedLock("B")
+            with a:
+                with b:
+                    pass
+            with b:
+                with pytest.raises(LockOrderError):
+                    a.acquire()
+
+    def test_two_thread_deadlock_caught_before_hang(self):
+        # the LIVE interleaving: T1 takes A then B, T2 takes B then A,
+        # a barrier forcing both outer locks held. Unchecked this
+        # deadlocks; the checker fails exactly one thread fast and
+        # BOTH threads finish.
+        with fresh_state():
+            a = _CheckedLock("A")
+            b = _CheckedLock("B")
+            barrier = threading.Barrier(2, timeout=10)
+            errs = []
+
+            def run(first, second):
+                with first:
+                    barrier.wait()
+                    try:
+                        with second:
+                            pass
+                    except LockOrderError as e:
+                        errs.append(e)
+
+            t1 = threading.Thread(target=run, args=(a, b))
+            t2 = threading.Thread(target=run, args=(b, a))
+            t1.start()
+            t2.start()
+            t1.join(10)
+            t2.join(10)
+            assert not t1.is_alive() and not t2.is_alive()
+            assert len(errs) == 1
+            assert "cycle" in str(errs[0])
+
+    def test_self_deadlock_raises(self):
+        with fresh_state():
+            a = _CheckedLock("A")
+            with a:
+                with pytest.raises(LockOrderError):
+                    a.acquire()
+
+    def test_rlock_reentry_no_edges(self):
+        with fresh_state() as st:
+            r = _CheckedRLock("R")
+            with r:
+                with r:
+                    pass
+            assert st.edge_list() == []
+
+    def test_trylock_probe_records_but_never_raises(self):
+        # `_locked`'s contention fast path: a non-blocking probe in
+        # cycle-closing order records the edge (for the dump) but
+        # cannot deadlock, so it must not raise
+        with fresh_state() as st:
+            a = _CheckedLock("A")
+            b = _CheckedLock("B")
+            with a:
+                with b:
+                    pass
+            with b:
+                assert a.acquire(blocking=False)
+                a.release()
+            assert ["B", "A"] in st.edge_list()
+
+    def test_nesting_records_all_pairs(self):
+        with fresh_state() as st:
+            a = _CheckedLock("A")
+            b = _CheckedLock("B")
+            c = _CheckedLock("C")
+            with a:
+                with b:
+                    with c:
+                        pass
+            assert st.edge_list() == [
+                ["A", "B"], ["A", "C"], ["B", "C"],
+            ]
+
+    def test_condition_wait_notify_roundtrip(self, monkeypatch):
+        # Condition built on a checked lock: wait() releases and
+        # re-acquires through the held-stack bookkeeping
+        monkeypatch.setenv("NR_TPU_LOCKCHECK", "1")
+        with fresh_state():
+            cond = make_condition("Fixture._cond")
+            results = []
+
+            def waiter():
+                with cond:
+                    results.append(cond.wait(timeout=10))
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            deadline = time.time() + 10
+            while not results and time.time() < deadline:
+                with cond:
+                    cond.notify_all()
+                time.sleep(0.005)
+            t.join(10)
+            assert results == [True]
+
+    def test_checked_rlock_condition_roundtrip(self, monkeypatch):
+        # the paired-lock idiom on a reentrant lock: Condition uses
+        # _release_save/_acquire_restore, which must keep the
+        # held-stack count balanced through the wait
+        monkeypatch.setenv("NR_TPU_LOCKCHECK", "1")
+        with fresh_state() as st:
+            rlock = make_rlock("Fixture._lock")
+            cond = make_condition("Fixture._lock", lock=rlock)
+            with cond:
+                assert not cond.wait(timeout=0.01)  # times out
+            assert st.held() == []
+
+
+class TestFactoryContract:
+    def test_passthrough_when_disabled(self, monkeypatch):
+        monkeypatch.delenv("NR_TPU_LOCKCHECK", raising=False)
+        assert type(make_lock("X._lock")) is type(threading.Lock())
+        assert type(make_rlock("X._rlock")) is type(threading.RLock())
+        assert isinstance(make_condition("X._cond"), threading.Condition)
+
+    def test_checked_when_enabled(self, monkeypatch):
+        monkeypatch.setenv("NR_TPU_LOCKCHECK", "1")
+        with fresh_state():
+            assert isinstance(make_lock("X._lock"), _CheckedLock)
+            assert isinstance(make_rlock("X._rlock"), _CheckedRLock)
+
+    def test_dump_merges_existing(self, tmp_path):
+        path = tmp_path / "lockgraph.json"
+        path.write_text(json.dumps({"edges": [["P", "Q"]]}))
+        with fresh_state():
+            a = _CheckedLock("A")
+            b = _CheckedLock("B")
+            with a:
+                with b:
+                    pass
+            dump_lockgraph(str(path))
+        data = json.loads(path.read_text())
+        assert ["A", "B"] in data["edges"]
+        assert ["P", "Q"] in data["edges"]
+
+
+# ------------------------------------------------------------- CLI gates
+
+
+class TestCLI:
+    AB = """
+        import threading
+
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def ab():
+            with lock_a:
+                with lock_b:
+                    pass
+    """
+
+    def test_lockgraph_out_and_check_dynamic(self, tmp_path):
+        src = tmp_path / "snippet.py"
+        src.write_text(textwrap.dedent(self.AB))
+        out = tmp_path / "static.json"
+        assert main([str(src), "--lockgraph-out", str(out)]) == 0
+        data = json.loads(out.read_text())
+        assert ["snippet.lock_a", "snippet.lock_b"] in data["edges"]
+
+        dyn = tmp_path / "dyn.json"
+        dyn.write_text(json.dumps(
+            {"edges": [["snippet.lock_a", "snippet.lock_b"]]}
+        ))
+        assert main([str(src), "--check-dynamic", str(dyn)]) == 0
+        dyn.write_text(json.dumps({"edges": [["rogue.x", "rogue.y"]]}))
+        assert main([str(src), "--check-dynamic", str(dyn)]) == 1
+
+    def test_suppressions_audit_flags_stale_and_unjustified(
+            self, tmp_path, capsys):
+        p = tmp_path / "s.py"
+        p.write_text(textwrap.dedent("""
+            import threading
+
+            x = 1  # nrlint: disable=nrcheck-guarded-by
+        """))
+        assert audit_suppressions([str(p)]) == 1
+        out = capsys.readouterr().out
+        assert "STALE" in out
+        assert "UNJUSTIFIED" in out
+
+    def test_suppressions_audit_accepts_live_justified(
+            self, tmp_path, capsys):
+        p = tmp_path / "s.py"
+        p.write_text(textwrap.dedent("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def bad(self):
+                    with self._cond:
+                        self._cond.wait()  # nrlint: disable=condition-wait-without-predicate-loop — fixture
+        """))
+        assert audit_suppressions([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "STALE" not in out and "UNJUSTIFIED" not in out
+
+    @pytest.mark.slow
+    def test_package_lint_is_clean(self):
+        # the acceptance gate: the analysis over the repo's own
+        # package must exit 0 (no unguarded shared-attribute access,
+        # acyclic lock-order graph, every suppression justified).
+        # slow-marked: two whole-package passes (~25s) — the tier-1
+        # budgeted run already gates lint-cleanliness through
+        # test_analysis.py::TestRepoIsClean (nrcheck rules included),
+        # and CI's nrlint job runs both CLI gates directly
+        assert main(["node_replication_tpu"]) == 0
+        assert audit_suppressions(["node_replication_tpu"]) == 0
