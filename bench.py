@@ -2147,6 +2147,692 @@ def follower_main(args) -> int:
     return 0
 
 
+def shard_primary_main(args) -> int:
+    """`--shard-primary` (internal): ONE shard primary process of the
+    `--sharded` fleet. The `--follower-primary` durable-ack pipeline
+    (seqreg NR + WAL + `DirectoryFeed` + a `ReplicationShipper`
+    installed as the frontend's `ack_barrier`, so every acked op is
+    BOTH fsynced and shipped — the property the parent's zero-lost-
+    acks gate rides across a promotion) with the submit path exposed
+    through a `ShardServer` instead of in-process client threads: the
+    parent's router is the only writer, and every sub-batch is
+    congruence- and version-checked at the door. The process watches
+    the fleet's published `ShardMap` and adopts bumped versions, so a
+    promotion elsewhere immediately fences stale peers at HELLO.
+    Never exits on its own: the parent SIGKILLs it."""
+    import os
+
+    from node_replication_tpu import NodeReplicated
+    from node_replication_tpu.durable import WriteAheadLog
+    from node_replication_tpu.durable.wal import durable_publish
+    from node_replication_tpu.models import SR_GET, SR_SET, make_seqreg
+    from node_replication_tpu.repl import DirectoryFeed, ReplicationShipper
+    from node_replication_tpu.serve import ServeConfig, ServeFrontend
+    from node_replication_tpu.shard import ShardMap, ShardServer
+
+    d = args.shard_dir
+    n_shards = args.sharded_shards
+    # client slots (clients x shards) plus one reserved probe slot per
+    # shard: the warm-up write below and the parent's stale-map fence
+    # check land there, keeping the verified client sequences clean
+    slots = args.sharded_clients * n_shards + n_shards
+    nr = NodeReplicated(
+        make_seqreg(slots),
+        n_replicas=1,
+        log_entries=1 << 15,
+        gc_slack=512,
+        exec_window=256,
+    )
+    wal = WriteAheadLog(os.path.join(d, "wal"), policy="batch")
+    nr.attach_wal(wal)
+    feed = DirectoryFeed(os.path.join(d, "feed"),
+                         arg_width=nr.spec.arg_width)
+    shipper = ReplicationShipper(wal, feed, poll_s=0.002,
+                                 heartbeat_interval_s=0.02)
+    fe = ServeFrontend(nr, ServeConfig(
+        queue_depth=args.serve_queue_depth,
+        batch_max_ops=args.serve_batch,
+        batch_linger_s=args.serve_linger,
+        durability="batch",
+    ))
+    fe.ack_barrier = shipper.barrier  # ship-before-ack
+    # warm the whole pipeline (combiner JIT + WAL + ship barrier +
+    # read plane) on this shard's reserved slot BEFORE opening the
+    # server, so the parent's first routed ops don't eat the compile
+    probe = args.sharded_clients * n_shards + args.shard_id
+    fe.call((SR_SET, probe, 0), rid=0)
+    fe.read((SR_GET, probe), rid=0)
+    m = ShardMap.load(args.shard_map_dir)
+    server = ShardServer(args.shard_id, fe, m, name="bench")
+    durable_publish(args.shard_port_file,
+                    f"{server.host} {server.port}".encode())
+    while True:  # adopt re-published maps until the parent kills us
+        time.sleep(0.05)
+        try:
+            cur = ShardMap.load(args.shard_map_dir)
+        except (OSError, ValueError, KeyError):
+            continue
+        if cur.version > m.version:
+            m = cur
+            server.set_map(m)
+
+
+def sharded_main(args) -> int:
+    """`--sharded`: the keyspace-sharded fleet gate (ISSUE 18).
+
+    Two legs over real processes (one shard primary per process, the
+    parent holding the `ShardRouter` + per-shard `Follower`s):
+
+    - **scaling**: closed-loop clients (one thread per (client, shard)
+      keyspace slot, monotone seqreg sequences verified on every
+      response) measure 1-shard baseline throughput, then the N-shard
+      fleet under the same per-shard load — aggregate acked writes
+      must clear `--sharded-scaling-min` x the baseline;
+    - **per-shard failover**: SIGKILL one shard's primary mid-load.
+      Its promotion (heartbeat silence -> parent-side
+      `PromotionManager` -> feed drained, epoch fenced) is measured as
+      RTO; the router re-homes the slice onto the promoted follower
+      under a bumped, durably re-published `ShardMap`; and hard gates
+      verify zero lost / zero duplicated acked writes on the victim
+      slice (journal per-slot chain scan + register floor), shard
+      isolation of the victim's journal, BOTH zombie fences (the dead
+      primary's epoch at the feed, a stale map version at a survivor's
+      HELLO), and that the OTHER shards' goodput from the kill through
+      the post window holds `--sharded-hold-min` of their pre-kill
+      rate — a shard's death must cost its own slice an RTO and
+      nobody else anything.
+    """
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from node_replication_tpu.harness.mkbench import (
+        append_sharded_csv,
+        sharded_rows,
+    )
+    from node_replication_tpu.models import SR_GET, SR_SET, make_seqreg
+    from node_replication_tpu.repl import (
+        DirectoryFeed,
+        EpochFencedError,
+        Follower,
+        PromotionManager,
+    )
+    from node_replication_tpu.serve import (
+        RetryPolicy,
+        ServeConfig,
+        ShardUnavailable,
+        WrongShard,
+        call_with_retry,
+    )
+    from node_replication_tpu.shard import (
+        LocalBackend,
+        ShardMap,
+        ShardRouter,
+        SocketShardClient,
+    )
+
+    clients = args.sharded_clients
+    n_shards = args.sharded_shards
+    window = args.sharded_seconds
+    base = args.sharded_dir or tempfile.mkdtemp(prefix="nr-sharded-")
+    failures: list[str] = []
+    retry = RetryPolicy(max_attempts=128, base_backoff_s=0.001,
+                        max_backoff_s=0.1)
+
+    class _PooledBackend:
+        """Per-shard connection pool behind the single-backend
+        surface the router expects: one `SocketShardClient` per
+        concurrent caller. A shard's clients are independent closed
+        loops — parallel in-flight submits are the combiner's
+        batching feedstock — and one shared connection would
+        serialize them into linger-long rounds of one op each. A map
+        adoption re-arms idle connections lazily (each replays HELLO
+        under the new version on its next checkout), never blocking
+        the adopt path on an in-flight request."""
+
+        def __init__(self, shard: int, address):
+            self.shard = shard
+            self._plock = threading.Lock()
+            self._address = address
+            self._map = None  # newest adopted ShardMap (None = v1)
+            self._version = 1
+            self._idle: list = []  # (armed_version, client)
+            self._all: list = []
+
+        def submit_batch(self, ops, peer_version, **kw):
+            with self._plock:
+                if self._idle:
+                    ver, c = self._idle.pop()
+                    if ver != self._version:
+                        c.update_version(self._map)
+                else:
+                    c = SocketShardClient(
+                        self.shard, self._address, self._version,
+                        io_timeout_s=60.0,
+                    )
+                    self._all.append(c)
+                got = self._version
+            try:
+                return c.submit_batch(ops, peer_version, **kw)
+            finally:
+                with self._plock:
+                    self._idle.append((got, c))
+
+        def update_version(self, m) -> None:
+            with self._plock:
+                self._version = m.version
+                self._map = m
+                addr = m.addresses[self.shard]
+                if addr is not None:
+                    self._address = (str(addr[0]), int(addr[1]))
+
+        def close(self) -> None:
+            with self._plock:
+                for c in self._all:
+                    c.close()
+
+    class _Fleet:
+        """One leg's fleet: N shard-primary processes behind a router
+        (socket backends), a parent-side follower per shard (the
+        per-shard replication tree), and closed-loop client threads
+        driving disjoint keyspace slots (`slot = client * N + shard`,
+        so `slot % N == shard` — the congruence contract)."""
+
+        def __init__(self, tag: str, n: int):
+            self.n = n
+            self.d = os.path.join(base, tag)
+            self.map_d = os.path.join(self.d, "map")
+            os.makedirs(self.map_d, exist_ok=True)
+            self.map = ShardMap(n)
+            self.map.publish(self.map_d)
+            self.children: list = []
+            self.logs: list = []
+            port_files = []
+            for s in range(n):
+                sd = os.path.join(self.d, f"s{s}")
+                os.makedirs(os.path.join(sd, "feed"), exist_ok=True)
+                pf = os.path.join(sd, "port")
+                port_files.append(pf)
+                log = open(os.path.join(sd, "child.log"), "w")
+                self.logs.append(log)
+                self.children.append(subprocess.Popen(
+                    [
+                        sys.executable, os.path.abspath(__file__),
+                        "--shard-primary",
+                        "--shard-id", str(s),
+                        "--shard-dir", sd,
+                        "--shard-map-dir", self.map_d,
+                        "--shard-port-file", pf,
+                        "--sharded-shards", str(n),
+                        "--sharded-clients", str(clients),
+                        "--serve-queue-depth",
+                        str(args.serve_queue_depth),
+                        "--serve-batch", str(args.serve_batch),
+                        "--serve-linger",
+                        str(args.sharded_linger),
+                    ],
+                    stdout=log, stderr=log,
+                ))
+            self.addrs = []
+            t_end = time.monotonic() + args.sharded_timeout
+            for s, pf in enumerate(port_files):
+                while True:
+                    if self.children[s].poll() is not None:
+                        raise RuntimeError(
+                            f"shard {s} exited early (rc "
+                            f"{self.children[s].returncode}); see "
+                            f"{self.d}/s{s}/child.log"
+                        )
+                    if time.monotonic() > t_end:
+                        raise RuntimeError(
+                            f"shard {s} never published its port; "
+                            f"see {self.d}/s{s}/child.log"
+                        )
+                    try:
+                        with open(pf) as f:
+                            host, port = f.read().split()
+                        self.addrs.append((host, int(port)))
+                        break
+                    except (FileNotFoundError, ValueError):
+                        time.sleep(0.05)
+            self.dispatch = make_seqreg(clients * n + n)
+            # the parent follows shard 0's feed (the fleet leg's
+            # victim): one follower per shard is the full deployment,
+            # but ONE keeps the single-core CI box honest about the
+            # scaling leg — every shard still ships its durable feed,
+            # so a follower can attach to any of them at any time
+            self.feed = DirectoryFeed(
+                os.path.join(self.d, "s0", "feed"),
+                arg_width=self.dispatch.arg_width,
+            )
+            self.follower = Follower(
+                self.dispatch, self.feed,
+                os.path.join(self.d, "s0", "follower"),
+                config=ServeConfig(durability="batch"),
+                poll_s=0.002,
+                nr_kwargs=dict(n_replicas=1, log_entries=1 << 15,
+                               gc_slack=512, exec_window=256),
+            )
+            self.router = ShardRouter(
+                self.map,
+                {s: _PooledBackend(s, self.addrs[s])
+                 for s in range(n)},
+                map_path=self.map_d,
+            )
+            self.lock = threading.Lock()
+            self.stop = threading.Event()
+            self.acked_max: dict[int, int] = {}
+            self.ack_count = [0] * n  # per shard, monotone
+            self.parked: dict[int, tuple[int, bool]] = {}
+            self.errors: list[str] = []
+            self.threads: list = []
+            for c in range(clients):
+                for s in range(n):
+                    self.start_client(c * n + s, s, 1)
+
+        def start_client(self, slot: int, s: int, start_i: int):
+            t = threading.Thread(
+                target=self._client, args=(slot, s, start_i),
+                name=f"bench-shard-client-{slot}", daemon=True,
+            )
+            self.threads.append(t)
+            t.start()
+
+        def _client(self, slot: int, s: int, i: int) -> None:
+            while not self.stop.is_set():
+                try:
+                    resp = call_with_retry(
+                        self.router, (SR_SET, slot, int(i)),
+                        policy=retry,
+                    )
+                except (ShardUnavailable, WrongShard) as e:
+                    # the slice is down past the retry budget, or the
+                    # op is in doubt (sent, response lost): park — the
+                    # parent verifies this slot against the promoted
+                    # follower's journaled truth and resumes it
+                    doubt = (isinstance(e, ShardUnavailable)
+                             and e.maybe_executed)
+                    with self.lock:
+                        self.parked[slot] = (int(i), doubt)
+                    return
+                with self.lock:
+                    if int(resp) != i - 1:
+                        self.errors.append(
+                            f"slot {slot} op {i}: expected {i - 1}, "
+                            f"got {resp} (ack chain broken)"
+                        )
+                    self.acked_max[slot] = int(i)
+                    self.ack_count[s] += 1
+                i += 1
+
+        def counts(self) -> list:
+            with self.lock:
+                return list(self.ack_count)
+
+        def warmup(self) -> None:
+            t_end = time.monotonic() + args.sharded_timeout
+            while min(self.counts()) < 25:
+                for s, ch in enumerate(self.children):
+                    if ch.poll() is not None:
+                        raise RuntimeError(
+                            f"shard {s} died during warmup (rc "
+                            f"{ch.returncode}); see "
+                            f"{self.d}/s{s}/child.log"
+                        )
+                if time.monotonic() > t_end:
+                    raise RuntimeError(
+                        f"fleet never warmed up: per-shard acks "
+                        f"{self.counts()} after "
+                        f"{args.sharded_timeout}s"
+                    )
+                time.sleep(0.05)
+
+        def close(self) -> None:
+            self.stop.set()
+            for t in self.threads:
+                t.join(timeout=10.0)
+            with self.lock:
+                failures.extend(self.errors)
+                self.errors.clear()
+            for ch in self.children:
+                if ch.poll() is None:
+                    os.kill(ch.pid, signal.SIGKILL)
+            for ch in self.children:
+                ch.wait()
+            self.router.close()
+            try:
+                self.follower.close()
+            except Exception:
+                pass
+            for log in self.logs:
+                log.close()
+
+    def rate_window(fleet: "_Fleet", seconds: float) -> list:
+        c0 = fleet.counts()
+        t0 = time.monotonic()
+        time.sleep(seconds)
+        c1 = fleet.counts()
+        dt = time.monotonic() - t0
+        return [(b - a) / dt for a, b in zip(c0, c1)]
+
+    # ---- leg 1: the 1-shard baseline (same per-shard client load) --
+    baseline_ops = 0.0
+    if args.sharded_scaling_min > 0:
+        fl = _Fleet("baseline", 1)
+        try:
+            fl.warmup()
+            baseline_ops = sum(rate_window(fl, window))
+        finally:
+            fl.close()
+        if fl.parked:
+            failures.append(
+                f"baseline clients parked with no fault injected: "
+                f"{sorted(fl.parked)}"
+            )
+        print(
+            f"# baseline: 1 shard x {clients} clients -> "
+            f"{baseline_ops:.1f} acked writes/s",
+            file=sys.stderr,
+        )
+
+    # ---- leg 2: the N-shard fleet, then SIGKILL one slice ----------
+    victim = 0
+    fl = _Fleet("fleet", n_shards)
+    try:
+        fl.warmup()
+        pre = rate_window(fl, window)
+        aggregate_ops = sum(pre)
+        manager = PromotionManager(
+            fl.feed, [fl.follower],
+            heartbeat_timeout_s=args.sharded_heartbeat_timeout,
+            check_interval_s=0.03,
+        )
+        manager.start()
+        c_kill = fl.counts()
+        victim_acked = c_kill[victim]
+        t_kill = time.monotonic()
+        os.kill(fl.children[victim].pid, signal.SIGKILL)
+        report = manager.wait(timeout=args.sharded_timeout)
+        manager.stop()
+        if report is None:
+            for f in failures:
+                print(f"# FAIL: {f}", file=sys.stderr)
+            print("# FAIL: promotion did not complete (no report)",
+                  file=sys.stderr)
+            return 1
+        follower = fl.follower
+        if not follower.promoted or follower.frontend.read_only:
+            failures.append(
+                "follower not serving writes after promotion"
+            )
+        # re-home: bump + durably re-publish FIRST (fences every stale
+        # peer fleet-wide), then repoint the router onto the promoted
+        # follower in-process — the same order ShardGroup.promote pins
+        new_map = fl.router.map.with_address(victim, None)
+        new_map.publish(fl.map_d)
+        fl.router.repoint(
+            victim,
+            LocalBackend(victim, follower.frontend, new_map),
+            new_map=new_map,
+        )
+        # resume parked victim slots from the journaled truth: the
+        # register must hold exactly the acked floor, or (for an
+        # in-doubt op) the pending value whose response was lost
+        time.sleep(0.2)
+        lost = 0
+        with fl.lock:
+            parked = dict(fl.parked)
+            fl.parked.clear()
+        for slot in sorted(parked):
+            pending, doubt = parked[slot]
+            s = slot % n_shards
+            if s != victim:
+                failures.append(
+                    f"slot {slot} (shard {s}) parked during shard "
+                    f"{victim}'s outage — a survivor slice observed "
+                    f"the failure"
+                )
+                continue
+            v = int(follower.frontend.read((SR_GET, slot), rid=0))
+            acked = fl.acked_max.get(slot, 0)
+            if v < acked:
+                lost += acked - v
+                failures.append(
+                    f"slot {slot}: acked up to {acked} but the "
+                    f"promoted follower holds {v} (LOST ACKED WRITES)"
+                )
+            elif v != acked and not (doubt and v == pending):
+                failures.append(
+                    f"slot {slot}: journal holds {v} vs acked {acked}"
+                    f" / pending {pending} (INVENTED WRITE)"
+                )
+            with fl.lock:
+                fl.acked_max[slot] = max(acked, v)
+            fl.start_client(slot, s, v + 1)
+        # post window: measured from the KILL, so the victim's outage
+        # and the re-home are inside it — survivors must not notice
+        time.sleep(window)
+        c_end = fl.counts()
+        t_end_m = time.monotonic()
+        post = [(b - a) / (t_end_m - t_kill)
+                for a, b in zip(c_kill, c_end)]
+        surv_pre = sum(r for s, r in enumerate(pre) if s != victim)
+        surv_post = sum(r for s, r in enumerate(post) if s != victim)
+        survivor_hold = (surv_post / surv_pre) if surv_pre > 0 else 0.0
+        if c_end[victim] <= c_kill[victim]:
+            failures.append(
+                f"victim shard {victim} served nothing after the "
+                f"re-home ({c_kill[victim]} -> {c_end[victim]} acks)"
+            )
+        fl.stop.set()
+        for t in fl.threads:
+            t.join(timeout=10.0)
+        with fl.lock:
+            failures.extend(fl.errors)
+            fl.errors.clear()
+            if fl.parked:
+                failures.append(
+                    f"clients parked after the re-home: "
+                    f"{sorted(fl.parked)}"
+                )
+            acked_snapshot = dict(fl.acked_max)
+
+        # no lost ack: every verified ack is in the promoted registers
+        for slot in sorted(acked_snapshot):
+            if slot % n_shards != victim:
+                continue
+            v = int(follower.frontend.read((SR_GET, slot), rid=0))
+            if v < acked_snapshot[slot]:
+                lost += acked_snapshot[slot] - v
+                failures.append(
+                    f"slot {slot}: acked up to "
+                    f"{acked_snapshot[slot]} but the promoted "
+                    f"follower holds {v} (LOST ACKED WRITES)"
+                )
+
+        # no duplicate + shard isolation: the promoted follower's
+        # journal holds ONLY the victim's congruence class, and each
+        # client slot's history chains 1..k with no repeat
+        duplicated = 0
+        seen_next: dict[int, int] = {}
+        for rec in follower.nr.wal.records(0):
+            for _opc, row in zip(rec.opcodes, rec.args):
+                slot = int(row[0])
+                if slot % n_shards != victim:
+                    failures.append(
+                        f"shard-isolation violation: slot {slot} "
+                        f"(shard {slot % n_shards}) journaled in "
+                        f"shard {victim}'s slice"
+                    )
+                    continue
+                if slot >= clients * n_shards:
+                    continue  # reserved warm-up/probe slot
+                v = int(row[1])
+                nxt = seen_next.get(slot, 1)
+                if v < nxt:
+                    duplicated += 1
+                    failures.append(
+                        f"slot {slot}: value {v} journaled again "
+                        f"after reaching {nxt - 1} (DUPLICATED)"
+                    )
+                elif v > nxt:
+                    failures.append(
+                        f"slot {slot}: journal skips from {nxt - 1} "
+                        f"to {v} (hole in history)"
+                    )
+                    seen_next[slot] = v + 1
+                else:
+                    seen_next[slot] = v + 1
+
+        # zombie fence, log plane: the dead primary's epoch can no
+        # longer publish into its shard's feed
+        try:
+            fl.feed.publish(
+                report.new_epoch - 1, follower.applied_pos(),
+                np.zeros(1, np.int32),
+                np.zeros((1, fl.dispatch.arg_width), np.int32),
+            )
+            failures.append(
+                "feed accepted a publish stamped with the dead "
+                "primary's epoch (zombie not fenced)"
+            )
+        except EpochFencedError:
+            pass
+
+        # zombie fence, routing tier: once a survivor adopts the
+        # re-published map, a peer still carrying the old version is
+        # refused at HELLO (typed WrongShard, zero log effect)
+        surv = (victim + 1) % n_shards
+        probe_slot = clients * n_shards + surv
+        fence_ok = False
+        probe_i = 0
+        t_f = time.monotonic() + 10.0
+        while time.monotonic() < t_f:
+            stale = SocketShardClient(surv, fl.addrs[surv], 1)
+            try:
+                probe_i += 1
+                stale.submit_batch([(SR_SET, probe_slot, probe_i)], 1)
+                time.sleep(0.1)  # survivor has not adopted v2 yet
+            except WrongShard:
+                fence_ok = True
+                break
+            except ShardUnavailable as e:
+                failures.append(
+                    f"survivor shard {surv} unreachable during the "
+                    f"stale-map fence check: {e}"
+                )
+                break
+            finally:
+                stale.close()
+        if not fence_ok and not any("unreachable" in f
+                                    for f in failures):
+            failures.append(
+                f"survivor shard {surv} still accepts map-version-1 "
+                f"submits after the promotion published version "
+                f"{new_map.version} (stale router not fenced)"
+            )
+
+        # serves on THROUGH THE ROUTER: each victim slot continues its
+        # sequence over the re-homed path with verified responses
+        post_ops = 0
+        for c in range(clients):
+            slot = c * n_shards + victim
+            v = int(follower.frontend.read((SR_GET, slot), rid=0))
+            for i in range(v + 1, v + 4):
+                resp = call_with_retry(fl.router, (SR_SET, slot, i),
+                                       policy=retry)
+                if int(resp) != i - 1:
+                    failures.append(
+                        f"post-promotion slot {slot} op {i}: "
+                        f"expected {i - 1}, got {resp}"
+                    )
+                post_ops += 1
+        acked_total = sum(fl.counts()) + post_ops
+    finally:
+        fl.close()
+
+    scaling_x = (aggregate_ops / baseline_ops) if baseline_ops else 0.0
+    if baseline_ops and scaling_x < args.sharded_scaling_min:
+        failures.append(
+            f"{n_shards} shards scaled only {scaling_x:.2f}x over the "
+            f"1-shard baseline ({aggregate_ops:.1f} vs "
+            f"{baseline_ops:.1f} acked writes/s; gate "
+            f"{args.sharded_scaling_min}x)"
+        )
+    if survivor_hold < args.sharded_hold_min:
+        failures.append(
+            f"survivor goodput held only {survivor_hold:.2f} of the "
+            f"pre-kill window through shard {victim}'s outage (gate "
+            f"{args.sharded_hold_min})"
+        )
+
+    run = {
+        "n_shards": n_shards,
+        "clients": clients * n_shards,
+        "duration": window,
+        "baseline_ops": baseline_ops,
+        "aggregate_ops": aggregate_ops,
+        "scaling_x": scaling_x,
+        "acked": acked_total,
+        "victim_shard": victim,
+        "victim_acked": victim_acked,
+        "detect_s": report.detect_s,
+        "promote_s": report.promote_s,
+        "rto_s": report.rto_s,
+        "survivor_hold": survivor_hold,
+        "lost": lost,
+        "duplicated": duplicated,
+        "post_promote_ops": post_ops,
+    }
+    append_sharded_csv(args.serve_out, sharded_rows("bench", run))
+    print(json.dumps({
+        "metric": "sharded_scaling_x",
+        "value": round(scaling_x, 3),
+        "unit": "x",
+        "n_shards": n_shards,
+        "clients_per_shard": clients,
+        "baseline_ops": round(baseline_ops, 1),
+        "aggregate_ops": round(aggregate_ops, 1),
+        "acked": acked_total,
+        "victim_shard": victim,
+        "victim_acked_before_kill": victim_acked,
+        "detect_s": round(report.detect_s, 4),
+        "promote_s": round(report.promote_s, 4),
+        "rto_s": round(report.rto_s, 4),
+        "new_epoch": report.new_epoch,
+        "map_version": new_map.version,
+        "survivor_hold": round(survivor_hold, 3),
+        "lost": lost,
+        "duplicated": duplicated,
+        "post_promote_ops": post_ops,
+    }))
+    if not args.sharded_dir:
+        shutil.rmtree(base, ignore_errors=True)
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# sharded OK: {n_shards} shards x {clients} clients -> "
+        f"{aggregate_ops:.1f} acked writes/s"
+        + (f" ({scaling_x:.2f}x the 1-shard baseline)"
+           if baseline_ops else "")
+        + f"; SIGKILL shard {victim} -> promotion in "
+          f"{report.rto_s:.3f}s (detect {report.detect_s:.3f}s + "
+          f"promote {report.promote_s:.3f}s), survivors held "
+          f"{survivor_hold:.2f}, lost 0, duplicated 0, both zombie "
+          f"fences proven, map v{new_map.version}, served "
+          f"{post_ops} more ops through the re-homed router",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def tree_follower_main(args) -> int:
     """`--tree-follower` (internal): one LEAF follower process of the
     `--tree` harness. Connects to its assigned relay over TCP, catches
@@ -3195,15 +3881,92 @@ def main():
     tree.add_argument("--obs-port-file", default=None,
                       help=argparse.SUPPRESS)  # internal: child
     # processes publish their exporter address here (--tree-obs)
+    sharded = p.add_argument_group(
+        "sharded", "keyspace-sharded fleet benchmark (--sharded): N "
+                   "shard-primary processes (each the --follower "
+                   "durable-ack pipeline: WAL + shipped feed + "
+                   "ship-before-ack) behind a ShardRouter, with a "
+                   "parent-side follower per shard; exits 1 unless "
+                   "aggregate acked-write throughput scales over the "
+                   "1-shard baseline, a SIGKILLed shard promotes its "
+                   "follower and re-homes under a bumped published "
+                   "ShardMap with zero lost/duplicated acks, and the "
+                   "other shards' goodput holds through the outage")
+    sharded.add_argument("--sharded", action="store_true",
+                         help="run the sharded-fleet benchmark")
+    sharded.add_argument("--sharded-shards", type=int, default=3,
+                         help="shard-primary processes in the fleet "
+                              "leg (the scaling gate is calibrated "
+                              "for 3; must be >= 2 for the kill leg)")
+    sharded.add_argument("--sharded-clients", type=int, default=4,
+                         help="closed-loop client threads PER shard "
+                              "(one thread per (client, shard) "
+                              "keyspace slot)")
+    sharded.add_argument("--sharded-seconds", type=float, default=3.0,
+                         help="measured window per leg")
+    sharded.add_argument("--sharded-linger", type=float,
+                         default=0.025,
+                         help="the shard primaries' combiner linger. "
+                              "Acked writes are LATENCY-bound rounds "
+                              "(linger + fsync + ship) — the regime "
+                              "where horizontal sharding pays and "
+                              "where the scaling leg measures fleet "
+                              "parallelism rather than one host's "
+                              "spare cores; a shard's concurrent "
+                              "clients batch into each round, so the "
+                              "linger amortizes, not serializes")
+    sharded.add_argument("--sharded-scaling-min", type=float,
+                         default=2.2,
+                         help="aggregate/baseline acked-write "
+                              "throughput gate (<= 0 skips the "
+                              "baseline leg entirely — the CI smoke "
+                              "mode, which keeps only the failover "
+                              "gates)")
+    sharded.add_argument("--sharded-hold-min", type=float,
+                         default=0.9,
+                         help="survivor goodput gate: the other "
+                              "shards' acked rate from the SIGKILL "
+                              "through the post window over their "
+                              "pre-kill window")
+    sharded.add_argument("--sharded-heartbeat-timeout", type=float,
+                         default=0.5,
+                         help="heartbeat silence before the victim's "
+                              "promotion watch strikes")
+    sharded.add_argument("--sharded-timeout", type=float,
+                         default=90.0,
+                         help="per-phase give-up budget (spawn, "
+                              "warmup, promotion)")
+    sharded.add_argument("--sharded-dir", default=None,
+                         help="working directory (default: a temp "
+                              "dir, removed after a clean run)")
+    sharded.add_argument("--shard-primary", action="store_true",
+                         help=argparse.SUPPRESS)  # internal: shard
+    sharded.add_argument("--shard-id", type=int, default=0,
+                         help=argparse.SUPPRESS)  # internal
+    sharded.add_argument("--shard-dir", default=None,
+                         help=argparse.SUPPRESS)  # internal
+    sharded.add_argument("--shard-map-dir", default=None,
+                         help=argparse.SUPPRESS)  # internal
+    sharded.add_argument("--shard-port-file", default=None,
+                         help=argparse.SUPPRESS)  # internal
     args = p.parse_args()
     if args.max_attempts < 1:
         p.error("--max-attempts must be >= 1")
     if sum(map(bool, (args.chaos, args.serve, args.crash,
                       args.follower, args.tree, args.overload,
-                      args.mesh, args.kernel))) > 1:
+                      args.mesh, args.kernel, args.sharded))) > 1:
         p.error("--chaos, --serve, --crash, --follower, --tree, "
-                "--overload, --mesh and --kernel are mutually "
-                "exclusive")
+                "--overload, --mesh, --kernel and --sharded are "
+                "mutually exclusive")
+    if args.sharded and args.sharded_shards < 2:
+        p.error("--sharded needs --sharded-shards >= 2 (the kill leg "
+                "promotes one shard while the others hold)")
+    if args.shard_primary:
+        if not args.shard_dir or not args.shard_map_dir \
+                or not args.shard_port_file:
+            p.error("--shard-primary requires --shard-dir, "
+                    "--shard-map-dir and --shard-port-file")
+        sys.exit(shard_primary_main(args))
     if args.crash_child:
         if not args.crash_dir:
             p.error("--crash-child requires --crash-dir")
@@ -3221,6 +3984,8 @@ def main():
         sys.exit(tree_follower_main(args))
     if args.follower:
         sys.exit(follower_main(args))
+    if args.sharded:
+        sys.exit(sharded_main(args))
     if args.tree:
         sys.exit(tree_main(args))
     if args.crash:

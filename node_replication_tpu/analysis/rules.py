@@ -1156,7 +1156,7 @@ def non_durable_publish(mod: ModuleInfo,
 
 #: package directories whose timed waits must route through the
 #: injectable clock (the simulation contract, `utils/clock.py`)
-_CLOCKED_SUBSYSTEMS = ("serve", "fault", "repl", "durable")
+_CLOCKED_SUBSYSTEMS = ("serve", "fault", "repl", "durable", "shard")
 
 _RAW_CLOCK_CALLS = {
     "time.monotonic": "time.monotonic() reads the OS clock directly",
@@ -1188,7 +1188,8 @@ def _clocked_subsystem(path: str) -> str | None:
 @rule(
     "raw-clock-in-subsystem", WARNING,
     "direct time.monotonic/time.sleep/Condition.wait in a "
-    "clock-routed subsystem (serve/, fault/, repl/, durable/)",
+    "clock-routed subsystem (serve/, fault/, repl/, durable/, "
+    "shard/)",
 )
 def raw_clock_in_subsystem(mod: ModuleInfo,
                            project: Project) -> Iterator[Diagnostic]:
@@ -1973,7 +1974,7 @@ def device_sync_in_assembly(mod: ModuleInfo,
 # --------------------------------------------------------------------------
 
 _THREAD_NAMED_SUBSYSTEMS = frozenset(
-    {"serve", "repl", "fault", "durable", "obs"}
+    {"serve", "repl", "fault", "durable", "obs", "shard"}
 )
 
 
@@ -2018,3 +2019,72 @@ def unnamed_worker_thread(mod: ModuleInfo,
             "it with the subsystem's role prefix "
             "(obs/profile._ROLE_PREFIXES)",
         )
+
+# --------------------------------------------------------------------------
+# unrouted-key-in-shard-path
+# --------------------------------------------------------------------------
+
+#: submit surfaces of the serve frontend a shard/ function may only
+#: reach AFTER a ShardMap lookup proved (or verified) the key's owner
+_SHARD_SUBMIT_METHODS = frozenset({"submit", "execute_mut_batch"})
+
+#: ShardMap lookups that constitute the routing step (`shard/ring.py`)
+_SHARD_LOOKUP_CALLS = frozenset(
+    {"shard_of", "shard_of_op", "split_batch"}
+)
+
+
+@rule(
+    "unrouted-key-in-shard-path", ERROR,
+    "frontend submit in shard/ with no ShardMap lookup in the same "
+    "function",
+)
+def unrouted_key_in_shard_path(mod: ModuleInfo,
+                               project: Project) -> Iterator[Diagnostic]:
+    """The fleet-level LogMapper invariant, machine-checked like the
+    in-process one: every write that reaches a `ServeFrontend` inside
+    shard/ must have been routed — or re-verified — through the
+    `ShardMap` congruence lookup (`shard_of` / `shard_of_op` /
+    `split_batch`, `shard/ring.py`). A direct `.submit(...)` /
+    `.execute_mut_batch(...)` in a shard/ function with NO lookup in
+    that function is a path that can write a key into the wrong
+    keyspace slice — silently, because the frontend itself has no idea
+    shards exist; the mis-route would only surface as a cross-shard
+    isolation violation later (the exact bug class `WrongShard` exists
+    to make typed and immediate). Scoped per function: the lookup and
+    the submit belong in the same routing step, not "somewhere in the
+    module" — a verified sub-batch handed to a helper that submits
+    blind is still one stale-map refactor away from a mis-route.
+    Reads are exempt (any replica of any shard serves a read of ITS
+    slice; a mis-routed read returns a typed miss, not corruption)."""
+    parts = re.split(r"[\\/]+", mod.path)
+    if "shard" not in parts[:-1]:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        submits = []
+        routed = False
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr in _SHARD_SUBMIT_METHODS:
+                submits.append(sub)
+            elif f.attr in _SHARD_LOOKUP_CALLS:
+                routed = True
+        if routed:
+            continue
+        for sub in submits:
+            yield _diag(
+                mod, sub, "unrouted-key-in-shard-path",
+                f"{node.name}: .{sub.func.attr}() on a frontend "
+                f"inside shard/ with no ShardMap lookup "
+                f"(shard_of/shard_of_op/split_batch) in the same "
+                f"function — an unrouted key can land in the wrong "
+                f"keyspace slice; route (or re-verify) through the "
+                f"map before submitting",
+            )

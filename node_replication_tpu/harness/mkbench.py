@@ -64,6 +64,24 @@ REPLICATION_CSV = "replication_benchmarks.csv"
 TREE_CSV = "tree_benchmarks.csv"
 OVERLOAD_CSV = "overload_benchmarks.csv"
 MESH_CSV = "mesh_benchmarks.csv"
+SHARDED_CSV = "sharded_benchmarks.csv"
+# One row per sharded-fleet measurement (`bench.py --sharded`): N
+# keyspace-sharded primary processes behind a `ShardRouter`.
+# `baseline_ops` is the 1-shard acked-write throughput under the same
+# client load; `aggregate_ops`/`scaling_x` are the horizontal-scaling
+# claim (the gate: N=3 must clear 2.2x). The failover block is the
+# per-shard one — SIGKILL of `victim_shard`'s primary, parent-side
+# promotion, router re-home — with `survivor_hold` = the OTHER
+# shards' goodput during the outage window over their pre-kill
+# window (gate: >= 0.9), and the two hard gates `lost`/`duplicated`
+# from the per-shard ack-chain verifier (both must be 0).
+_SHARDED_FIELDS = [
+    "name", "n_shards", "clients", "duration",
+    "baseline_ops", "aggregate_ops", "scaling_x",
+    "acked", "victim_shard", "victim_acked",
+    "detect_s", "promote_s", "rto_s", "survivor_hold",
+    "lost", "duplicated", "post_promote_ops",
+]
 # One row per (device count) point of a mesh scaling curve
 # (`bench.py --mesh`): replayed-dispatch throughput at that width,
 # `scaling_x` = throughput / the curve's 1-device throughput, and
@@ -1706,6 +1724,35 @@ def tree_rows(name: str, run: dict) -> list[dict]:
 
 def append_tree_csv(out_dir: str, rows: list[dict]) -> None:
     _append_csv(os.path.join(out_dir, TREE_CSV), _TREE_FIELDS, rows)
+
+
+def sharded_rows(name: str, run: dict) -> list[dict]:
+    """The SHARDED_CSV row for one `bench.py --sharded` run dict (see
+    `_SHARDED_FIELDS` for the gated column groups)."""
+    return [{
+        "name": f"{name}/sharded-seqreg",
+        "n_shards": run["n_shards"],
+        "clients": run["clients"],
+        "duration": round(run["duration"], 3),
+        "baseline_ops": round(run["baseline_ops"], 1),
+        "aggregate_ops": round(run["aggregate_ops"], 1),
+        "scaling_x": round(run["scaling_x"], 3),
+        "acked": run["acked"],
+        "victim_shard": run["victim_shard"],
+        "victim_acked": run["victim_acked"],
+        "detect_s": round(run["detect_s"], 4),
+        "promote_s": round(run["promote_s"], 4),
+        "rto_s": round(run["rto_s"], 4),
+        "survivor_hold": round(run["survivor_hold"], 3),
+        "lost": run["lost"],
+        "duplicated": run["duplicated"],
+        "post_promote_ops": run["post_promote_ops"],
+    }]
+
+
+def append_sharded_csv(out_dir: str, rows: list[dict]) -> None:
+    _append_csv(os.path.join(out_dir, SHARDED_CSV),
+                _SHARDED_FIELDS, rows)
 
 
 def measure_native(

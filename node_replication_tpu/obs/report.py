@@ -754,6 +754,40 @@ def analyze(events: list[dict]) -> dict:
             ],
         }
 
+    # sharding section: routing-tier map adoptions + typed refusals
+    # from serve-reroute / shard-refused events (shard/router.py) —
+    # the keyspace-sharded fleet's re-home + zombie-fence story
+    sharding = None
+    reroutes = [e for e in events if e.get("event") == "serve-reroute"]
+    refusals = [e for e in events if e.get("event") == "shard-refused"]
+    if reroutes or refusals:
+        ref_by_shard: dict[int, int] = defaultdict(int)
+        ref_by_error: dict[str, int] = defaultdict(int)
+        for e in refusals:
+            ref_by_shard[int(e.get("shard", -1))] += 1
+            ref_by_error[str(e.get("error", "?"))] += 1
+        sharding = {
+            "map_adoptions": len(reroutes),
+            "final_map_version": max(
+                (int(e.get("map_version", 0)) for e in reroutes),
+                default=0,
+            ),
+            "adoptions": [
+                {"t": round(_event_time(e, mono0, ts0), 3),
+                 "reason": e.get("reason", "?"),
+                 "from_version": int(e.get("from_version", 0)),
+                 "map_version": int(e.get("map_version", 0)),
+                 "shards": list(e.get("shards", []) or [])}
+                for e in sorted(
+                    reroutes,
+                    key=lambda e: _event_time(e, mono0, ts0),
+                )
+            ],
+            "refused": len(refusals),
+            "refused_by_shard": dict(sorted(ref_by_shard.items())),
+            "refused_by_error": dict(sorted(ref_by_error.items())),
+        }
+
     # host budget section: per-stage host-CPU attribution from
     # profile-summary events (obs/profile.SamplingProfiler.emit_summary)
     # joined with the spans the profiler's stages mirror — the direct
@@ -825,6 +859,7 @@ def analyze(events: list[dict]) -> dict:
         "fault": fault,
         "durability": durability,
         "replication": repl,
+        "sharding": sharding,
         "fleet": fleet,
         "mesh": mesh,
         "kernels": kernels,
@@ -848,7 +883,8 @@ def render(report: dict, out=None) -> None:
     # below is absent because the trace holds none of its events, not
     # because the report crashed on partial data
     _sections = ("serve", "fault", "durability", "replication",
-                 "fleet", "mesh", "kernels", "host_budget")
+                 "sharding", "fleet", "mesh", "kernels",
+                 "host_budget")
     present = [s for s in _sections if report.get(s)]
     absent = [s for s in _sections if not report.get(s)]
     w(f"sections: {', '.join(present) if present else '(core only)'}"
@@ -1054,6 +1090,30 @@ def render(report: dict, out=None) -> None:
               f"({p['drained_records']} drained); detect "
               f"{_fmt_s(p['detect_s'])} + promote "
               f"{_fmt_s(p['promote_s'])} = RTO {_fmt_s(p['rto_s'])}\n")
+
+    shd = report.get("sharding")
+    if shd:
+        w("\n== sharding ==\n")
+        w(f"  map adoptions: {shd['map_adoptions']} (final version "
+          f"{shd['final_map_version']})   refused submits: "
+          f"{shd['refused']}\n")
+        for a in shd["adoptions"]:
+            moved = (",".join(f"s{s}" for s in a["shards"])
+                     if a["shards"] else "none")
+            w(f"  adoption t+{a['t']}s [{a['reason']}]: "
+              f"v{a['from_version']} -> v{a['map_version']}, "
+              f"re-homed: {moved}\n")
+        if shd["refused"]:
+            by_err = "   ".join(
+                f"{k}={v}"
+                for k, v in sorted(shd["refused_by_error"].items())
+            )
+            by_shard = "   ".join(
+                f"s{k}={v}"
+                for k, v in sorted(shd["refused_by_shard"].items())
+            )
+            w(f"  refusals by error: {by_err}\n")
+            w(f"  refusals by shard: {by_shard}\n")
 
     fleet = report.get("fleet")
     if fleet:

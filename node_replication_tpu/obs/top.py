@@ -31,7 +31,8 @@ import time
 
 from node_replication_tpu.obs.collect import FleetCollector
 
-_ROLE_ORDER = {"primary": 0, "relay": 1, "follower": 2}
+_ROLE_ORDER = {"router": 0, "primary": 1, "shard": 1, "relay": 2,
+               "follower": 3}
 
 _COLUMNS = ("node", "role", "applied", "ship-lag", "apply-lag",
             "limit", "shed", "burn", "host", "p99", "state")
@@ -99,7 +100,7 @@ def node_row(summary: dict) -> dict:
     return {
         "node": str(summary.get("node_id", "?")),
         "role": role,
-        "order": (_ROLE_ORDER.get(role, 3),
+        "order": (_ROLE_ORDER.get(role, 4),
                   str(summary.get("node_id", "?"))),
         "applied": _fmt(applied),
         "ship-lag": _fmt(metrics.get("repl.ship_lag_pos")),

@@ -30,7 +30,9 @@ from node_replication_tpu.serve.errors import (
     Overloaded,
     ReplicaFailed,
     ServeError,
+    ShardUnavailable,
     StaleRead,
+    WrongShard,
 )
 from node_replication_tpu.serve.frontend import (
     ServeConfig,
@@ -65,6 +67,8 @@ __all__ = [
     "ServeError",
     "ServeFrontend",
     "ServeFuture",
+    "ShardUnavailable",
     "StaleRead",
+    "WrongShard",
     "call_with_retry",
 ]

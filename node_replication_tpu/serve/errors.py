@@ -36,6 +36,17 @@ flat under `ServeError`:
   the circuit and the cool-down has not elapsed. The op was never
   submitted (zero log effect by construction); retry after
   `retry_after_s`, when the breaker's half-open probe window opens.
+- `WrongShard` — the fleet-sharding plane (`shard/`): an op whose key
+  routes to a different shard under the current `ShardMap`, or a
+  submit carrying a stale map version. The op was rejected before any
+  log effect; refresh the map and re-route
+  (`serve/client.py:call_with_retry` does so when the frontend
+  exposes `refresh_map()`).
+- `ShardUnavailable` — the op's shard cannot serve right now (its
+  primary died, its backend connection dropped, or its promotion is
+  in flight). Transient by design when `maybe_executed=False`;
+  `call_with_retry` backs off and retries, and the router re-routes
+  once the shard's `PromotionManager` re-homes it.
 """
 
 from __future__ import annotations
@@ -190,3 +201,72 @@ class CircuitOpen(ServeError):
         )
         self.retry_after_s = retry_after_s
         self.failures = failures
+
+
+class WrongShard(ServeError):
+    """The op's key does not belong to the shard it reached — or the
+    caller's `ShardMap` version disagrees with the shard's.
+
+    The fleet-level congruence contract (`shard/ring.py:ShardMap`,
+    lifted from `models/partitioned.py`): shard `s` of `N` owns every
+    key `k` with `k % N == s`, and routers and shards must agree on
+    the SAME map version before any ack. A key mismatch means a
+    caller bypassed the router; a version mismatch means a stale map
+    on one side (a re-published map after a promotion the other side
+    has not loaded yet). Either way the op was rejected BEFORE any
+    log effect — refresh the map (`durable_publish`'d, so a reload
+    always observes a complete file) and re-route; `call_with_retry`
+    does both when the frontend exposes `refresh_map()`.
+    """
+
+    def __init__(self, key: int, shard: int, expected_shard: int,
+                 map_version: int, peer_version: int | None = None):
+        if peer_version is not None and peer_version != map_version:
+            why = (f"map version {peer_version} does not match the "
+                   f"shard's version {map_version}")
+        else:
+            why = (f"key {key} routes to shard {expected_shard} "
+                   f"under map v{map_version}")
+        super().__init__(
+            f"shard {shard}: {why}; op rejected before any log effect"
+        )
+        self.key = key
+        self.shard = shard
+        self.expected_shard = expected_shard
+        self.map_version = map_version
+        self.peer_version = peer_version
+
+
+class ShardUnavailable(ServeError):
+    """The op's shard cannot serve it right now (`shard/router.py`).
+
+    Raised when a shard's backend is down — its primary process died,
+    the connection dropped mid-exchange, or a promotion is re-homing
+    its writes. `maybe_executed` has `ReplicaFailed` semantics: False
+    means the sub-batch provably never reached the shard's log, so a
+    resubmit is exactly-once safe (`call_with_retry` retries it with
+    backoff, re-routed once the router repoints the shard); True means
+    the connection died AFTER the ops were sent — they may commit and
+    replay, so only the caller can decide (a read disambiguates).
+
+    Cross-shard batches are NOT atomic (the CNR contract): when a
+    multi-shard batch raises this, sub-batches on OTHER shards may
+    have committed and acked independently.
+    """
+
+    def __init__(self, shard: int, cause: BaseException | None = None,
+                 maybe_executed: bool = False):
+        detail = f" ({type(cause).__name__}: {cause})" if cause else ""
+        effect = (
+            "sub-batch may have reached the shard's log; response lost"
+            if maybe_executed
+            else "sub-batch never reached the shard's log"
+        )
+        super().__init__(f"shard {shard} unavailable{detail}; {effect}")
+        self.shard = shard
+        self.cause = cause
+        self.maybe_executed = maybe_executed
+
+    @property
+    def retryable(self) -> bool:
+        return not self.maybe_executed

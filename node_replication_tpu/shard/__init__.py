@@ -1,0 +1,34 @@
+"""Fleet-level keyspace sharding: the paper's CNR partitioning lifted
+from logs to primaries.
+
+- `ring`: `ShardMap` — the deterministic `key % N` congruence map,
+  versioned + durably published.
+- `router`: `ShardRouter` (split → fan out → reassemble) over
+  `LocalBackend` / `SocketShardClient` backends, and `ShardServer`,
+  the shard primary's CRC-framed submit endpoint.
+- `primary`: `ShardPrimary` / `ShardGroup` — N primaries, each with
+  its own WAL, epoch, shipper, and follower tree.
+
+Cross-shard batches are explicitly NOT atomic (the CNR contract);
+see `shard/router.py` and README "Keyspace sharding".
+"""
+
+from node_replication_tpu.shard.primary import ShardGroup, ShardPrimary
+from node_replication_tpu.shard.ring import MAP_FILENAME, ShardMap
+from node_replication_tpu.shard.router import (
+    LocalBackend,
+    ShardRouter,
+    ShardServer,
+    SocketShardClient,
+)
+
+__all__ = [
+    "MAP_FILENAME",
+    "LocalBackend",
+    "ShardGroup",
+    "ShardMap",
+    "ShardPrimary",
+    "ShardRouter",
+    "ShardServer",
+    "SocketShardClient",
+]

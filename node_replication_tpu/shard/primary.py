@@ -1,0 +1,233 @@
+"""`ShardPrimary` / `ShardGroup`: N primaries, each owning a keyspace
+slice with its OWN replication stack.
+
+The fleet-level lift of CNR's per-log partitioning: where
+`MultiLogReplicated` gives each congruence class its own in-process
+log, a `ShardPrimary` gives it a whole primary — its own
+`NodeReplicated` wrapper, attached WAL, epoch, `ReplicationShipper`
+feed, and (optionally) a follower + `PromotionManager`. NOTHING here
+is new machinery: promotion, fencing, snapshot bootstrap, and
+recovery are the existing per-primary planes, instantiated once per
+shard — the subsystem's job is composition and the routing contract,
+not a second replication implementation.
+
+`ShardGroup` is the all-in-one composition (tests, examples, the
+embeddable deployment): N `ShardPrimary`s under one directory, a
+durably-published `ShardMap`, and a `ShardRouter` over
+`LocalBackend`s. Its failure story is the per-shard one: killing one
+shard's primary (`kill_primary`) fails exactly that keyspace slice —
+the other shards' frontends never see it — and `promote` re-homes the
+slice onto the shard's follower, bumps + re-publishes the map, and
+repoints the router, after which stale-map peers are fenced
+(`WrongShard`) and `call_with_retry` re-routes via `refresh_map`.
+Multi-process deployments (`bench.py --sharded`, the CI smoke) keep
+the same shapes but put each `ShardPrimary`'s stack in its own
+process behind a `ShardServer`.
+"""
+
+from __future__ import annotations
+
+import os
+
+from node_replication_tpu.shard.ring import ShardMap
+from node_replication_tpu.shard.router import LocalBackend, ShardRouter
+
+
+def _default_nr_kwargs() -> dict:
+    # the follower-fleet bench's per-primary sizing: one replica per
+    # shard process keeps the scaling measurement about SHARDS
+    return dict(n_replicas=1, log_entries=1 << 15, gc_slack=512,
+                exec_window=256)
+
+
+class ShardPrimary:
+    """One shard's complete primary stack over its keyspace slice.
+
+    Layout under `base_dir`: `primary/` (WAL + snapshots), `feed/`
+    (the shipper's directory feed), `follower/` (the standby's WAL).
+    The frontend acks ship-before-ack (`ack_barrier =
+    shipper.barrier`), so the group's zero-lost-acks property holds
+    per shard across a promotion — an acked op is fsynced AND in the
+    feed the follower drains.
+    """
+
+    def __init__(self, shard: int, dispatch, base_dir: str,
+                 shard_map: ShardMap, config=None,
+                 nr_kwargs: dict | None = None,
+                 with_follower: bool = True,
+                 heartbeat_timeout_s: float = 0.5,
+                 poll_s: float = 0.002,
+                 auto_start_watch: bool = False):
+        from node_replication_tpu import NodeReplicated
+        from node_replication_tpu.durable import WriteAheadLog
+        from node_replication_tpu.repl import (
+            DirectoryFeed,
+            Follower,
+            PromotionManager,
+            ReplicationShipper,
+        )
+        from node_replication_tpu.serve import ServeConfig, ServeFrontend
+
+        self.shard = int(shard)
+        self.map = shard_map
+        self.base_dir = base_dir
+        self.primary_dir = os.path.join(base_dir, "primary")
+        self.feed_dir = os.path.join(base_dir, "feed")
+        self.follower_dir = os.path.join(base_dir, "follower")
+        for p in (self.primary_dir, self.feed_dir, self.follower_dir):
+            os.makedirs(p, exist_ok=True)
+        cfg = config or ServeConfig(durability="batch")
+        if cfg.durability != "batch":
+            raise ValueError(
+                "sharded primaries require durable acks "
+                "(ServeConfig(durability='batch'))"
+            )
+        self.dispatch = dispatch
+        self.nr = NodeReplicated(
+            dispatch, **(nr_kwargs or _default_nr_kwargs())
+        )
+        self.wal = WriteAheadLog(
+            os.path.join(self.primary_dir, "wal"), policy="batch"
+        )
+        self.nr.attach_wal(self.wal)
+        self.feed = DirectoryFeed(
+            self.feed_dir, arg_width=self.nr.spec.arg_width
+        )
+        self.shipper = ReplicationShipper(
+            self.wal, self.feed, poll_s=poll_s,
+            heartbeat_interval_s=0.02,
+        )
+        self.frontend = ServeFrontend(self.nr, cfg)
+        self.frontend.ack_barrier = self.shipper.barrier
+        self.follower = None
+        self.manager = None
+        if with_follower:
+            self.follower = Follower(
+                dispatch, self.feed, self.follower_dir,
+                config=cfg, poll_s=poll_s,
+                nr_kwargs=nr_kwargs or _default_nr_kwargs(),
+            )
+            self.manager = PromotionManager(
+                self.feed, [self.follower],
+                heartbeat_timeout_s=heartbeat_timeout_s,
+                check_interval_s=0.03,
+            )
+            if auto_start_watch:
+                self.manager.start()
+        self._primary_dead = False
+
+    @property
+    def live_frontend(self):
+        """The frontend currently serving this shard's writes — the
+        primary's until `promote()`, the promoted follower's after."""
+        if (self.follower is not None and self.follower.promoted):
+            return self.follower.frontend
+        return self.frontend
+
+    def kill_primary(self) -> None:
+        """Fail this shard's primary abruptly (in-process stand-in for
+        SIGKILL): stop shipping — heartbeat silence is what the
+        `PromotionManager` detects — and tear the frontend down
+        non-draining so queued requests reject instead of hanging."""
+        if self._primary_dead:
+            return
+        self._primary_dead = True
+        self.shipper.stop(clear_pin=False)
+        self.frontend.close(drain=False)
+
+    def promote(self, detect_s: float = 0.0):
+        """Promote this shard's follower (detection done by the
+        caller's watch, or operator-initiated). Returns the
+        `PromotionReport`; `live_frontend` then serves writes."""
+        if self.manager is None:
+            raise RuntimeError(f"shard {self.shard} has no follower")
+        return self.manager.promote_now(detect_s=detect_s)
+
+    def close(self) -> None:
+        if not self._primary_dead:
+            self.shipper.stop()
+            self.frontend.close()
+        if self.follower is not None:
+            self.follower.close()
+        wal = self.nr.detach_wal()
+        if wal is not None:
+            wal.close()
+
+
+class ShardGroup:
+    """N `ShardPrimary`s + a published `ShardMap` + a `ShardRouter`.
+
+        group = ShardGroup(3, make_hashmap(1024), base_dir=d)
+        router = group.router
+        router.call((HM_SET, key, value))     # routed by key % 3
+        ...
+        group.kill_primary(1)                 # one slice fails
+        group.promote(1)                      # its follower takes over
+        router.call((HM_SET, key1, value))    # re-routed, still acked
+
+    `promote` bumps and RE-PUBLISHES the map before repointing the
+    router, so external routers watching the published file
+    (`refresh_map`) converge on the new topology, and any peer still
+    submitting under the old version gets `WrongShard` — the zombie
+    fence at the routing tier.
+    """
+
+    def __init__(self, n_shards: int, dispatch, base_dir: str,
+                 config=None, nr_kwargs: dict | None = None,
+                 with_followers: bool = True,
+                 heartbeat_timeout_s: float = 0.5,
+                 concurrent_router: bool = True):
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+        self.map = ShardMap(n_shards)
+        self.map.publish(base_dir)
+        self.primaries = [
+            ShardPrimary(
+                s, dispatch,
+                os.path.join(base_dir, f"s{s}"),
+                self.map, config=config, nr_kwargs=nr_kwargs,
+                with_follower=with_followers,
+                heartbeat_timeout_s=heartbeat_timeout_s,
+            )
+            for s in range(n_shards)
+        ]
+        self.router = ShardRouter(
+            self.map,
+            {
+                s: LocalBackend(s, self.primaries[s].frontend, self.map)
+                for s in range(n_shards)
+            },
+            map_path=base_dir,
+            concurrent=concurrent_router,
+        )
+
+    @property
+    def n_shards(self) -> int:
+        return self.map.n_shards
+
+    def kill_primary(self, shard: int) -> None:
+        self.primaries[int(shard)].kill_primary()
+
+    def promote(self, shard: int, detect_s: float = 0.0):
+        """Promote `shard`'s follower and re-home its writes: publish
+        the bumped map FIRST (external routers must be able to prove
+        the old version stale before the new home acks), then repoint
+        this group's router onto the promoted frontend."""
+        s = int(shard)
+        p = self.primaries[s]
+        report = p.promote(detect_s=detect_s)
+        new_map = self.map.with_address(s, None)
+        new_map.publish(self.base_dir)
+        self.map = new_map
+        for q in self.primaries:
+            q.map = new_map
+        self.router.repoint(
+            s, LocalBackend(s, p.live_frontend, new_map),
+            new_map=new_map,
+        )
+        return report
+
+    def close(self) -> None:
+        self.router.close()
+        for p in self.primaries:
+            p.close()

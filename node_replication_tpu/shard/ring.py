@@ -1,0 +1,147 @@
+"""`ShardMap`: the deterministic keyspace → shard congruence map.
+
+The fleet-level twin of the in-process `LogMapper` (PAPER.md's CNR
+layer; `models/partitioned.py` pins the same contract for device
+state): shard `s` of `N` owns every key `k` with `k % N == s`, where
+an op's key is `args[0]` — exactly the commutativity hash the benches
+and `MultiLogReplicated` use (`hash = args[0] % nlogs`). Two
+consequences the router relies on:
+
+- **determinism**: any two parties holding the same `(n_shards,
+  version)` route every op identically, with no coordination;
+- **commutativity across shards**: ops on different congruence
+  classes touch disjoint keys, so per-shard sub-batches may execute
+  concurrently and acks interleave freely — which is also why a
+  cross-shard batch is explicitly NOT atomic (see `shard/router.py`).
+
+The map is **versioned and durably published**: `publish()` writes
+the JSON document through `durable_publish` (atomic tmp + fsync +
+rename), so a concurrent reader observes either the previous complete
+map or the new complete map, never a torn one — the same discipline
+every other control file in the repo follows. Routers and shards
+compare versions on every (re)connect; a mismatch is a typed
+`WrongShard`, never a silent mis-route. Promotions bump the version
+(`with_address`) so a router that re-homed a shard's writes can prove
+any stale peer wrong.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from node_replication_tpu.durable.wal import durable_publish
+
+#: default published filename inside a fleet's shared directory
+MAP_FILENAME = "shard_map.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardMap:
+    """Immutable, versioned keyspace map.
+
+    `addresses[s]` is shard `s`'s submit endpoint — `[host, port]`
+    for a socket backend, `None` for a local/in-process one. Equality
+    of `(n_shards, version)` is the routing agreement the fleet
+    checks; addresses are advisory (how to reach the shard), the
+    congruence is the contract (which keys it owns).
+    """
+
+    n_shards: int
+    version: int = 1
+    addresses: tuple = ()
+
+    def __post_init__(self):
+        if self.n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        if self.version < 1:
+            raise ValueError("version must be >= 1")
+        addrs = tuple(
+            tuple(a) if a is not None else None for a in self.addresses
+        ) or (None,) * self.n_shards
+        if len(addrs) != self.n_shards:
+            raise ValueError(
+                f"{len(addrs)} addresses for {self.n_shards} shards"
+            )
+        object.__setattr__(self, "addresses", addrs)
+
+    # ---------------------------------------------------------- routing
+
+    def shard_of(self, key: int) -> int:
+        """Owning shard of `key`: the `key % N` congruence class."""
+        return int(key) % self.n_shards
+
+    def shard_of_op(self, op) -> int:
+        """Owning shard of one op `(opcode, *args)` — the key is
+        `args[0]`, matching the benches' LogMapper and the
+        partitioned model's congruence contract."""
+        if len(op) < 2:
+            raise ValueError(f"op {op!r} has no key argument")
+        return self.shard_of(op[1])
+
+    def split_batch(self, ops) -> dict[int, list[tuple[int, tuple]]]:
+        """Partition a batch into per-shard sub-batches, keeping each
+        op's submission index so responses reassemble in submission
+        order. Within one shard the sub-batch preserves submission
+        order; ACROSS shards sub-batches are independent (disjoint
+        congruence classes — the CNR commutativity argument)."""
+        groups: dict[int, list[tuple[int, tuple]]] = {}
+        for i, op in enumerate(ops):
+            groups.setdefault(self.shard_of_op(op), []).append(
+                (i, tuple(op))
+            )
+        return groups
+
+    # ------------------------------------------------------- publication
+
+    def with_address(self, shard: int, address) -> "ShardMap":
+        """A NEW map with `shard` re-pointed (a promotion re-homing
+        its writes) and the version bumped — publish it so every
+        router and shard can prove stale peers wrong."""
+        if not (0 <= int(shard) < self.n_shards):
+            raise ValueError(f"shard {shard} out of range")
+        addrs = list(self.addresses)
+        addrs[int(shard)] = tuple(address) if address is not None \
+            else None
+        return ShardMap(self.n_shards, self.version + 1, tuple(addrs))
+
+    def as_dict(self) -> dict:
+        return {
+            "n_shards": self.n_shards,
+            "version": self.version,
+            "addresses": [list(a) if a is not None else None
+                          for a in self.addresses],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ShardMap":
+        return cls(
+            n_shards=int(d["n_shards"]),
+            version=int(d["version"]),
+            addresses=tuple(
+                tuple(a) if a is not None else None
+                for a in d.get("addresses", [])
+            ),
+        )
+
+    def publish(self, path: str) -> None:
+        """Durably publish this map (atomic tmp + fsync + rename via
+        `durable_publish`) so routers and shards agree across
+        restarts. `path` may be a directory (the fleet's shared dir;
+        the map lands at `<path>/shard_map.json`) or a file path."""
+        if os.path.isdir(path):
+            path = os.path.join(path, MAP_FILENAME)
+        durable_publish(
+            path,
+            json.dumps(self.as_dict(), sort_keys=True).encode(),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "ShardMap":
+        """Load a published map. Always observes a COMPLETE document
+        (the `durable_publish` rename guarantee)."""
+        if os.path.isdir(path):
+            path = os.path.join(path, MAP_FILENAME)
+        with open(path, "rb") as f:
+            return cls.from_dict(json.loads(f.read().decode()))

@@ -28,6 +28,15 @@ Flavors:
   follower + promotion watcher, all stepped as scheduler quanta;
   seeded primary kill, heartbeat-silence detection in virtual time,
   election, epoch fence, promotion, post-failover serving.
+- ``sharded`` — a 2-shard keyspace fleet (ISSUE 18): per shard an NR
+  primary + WAL + feed + shipper + follower, fronted by the REAL
+  `ShardRouter` (`concurrent=False` — sequential shard-ordered
+  fan-out, so thread interleaving is not schedule noise). Routed
+  writes/batches/reads, per-shard ship/apply lanes, and a seeded
+  kill → typed-unavailability window → promotion → router re-home
+  tail. Generated entirely from a FRESH rng stream, so every other
+  flavor's schedule (and the canary-seed expectations) stays
+  byte-identical.
 
 Property catalog (each violation carries the property name):
 
@@ -58,6 +67,13 @@ Property catalog (each violation carries the property name):
   lower-priority op sat queued (the overload plane's strict-priority
   eviction exists to make this impossible; the queue counts it at
   the shed decision point, under its lock).
+- ``shard-isolation``    — a shard's ring holds a key outside its
+  `key % N` congruence class, or a shard's final state is not the
+  fold of EXACTLY the ops routed to it (an op leaked into the wrong
+  shard's keyspace slice). The sharded flavor also reuses
+  ``resp-diff`` (per-shard oracle), ``durable-ack-survival`` (a
+  promotion lost a shipped-acked op), ``zombie-unfenced``, and
+  ``log-content`` (lost/duplicated acks per shard).
 
 The serve flavor's ``burst`` steps drive the overload plane
 deterministically: a paused frontend (workers not started) admits a
@@ -86,7 +102,7 @@ from node_replication_tpu.utils.clock import SimClock, installed
 
 MODELS = ("hashmap", "stack", "queue", "seqreg")
 WRAPPERS = ("nr", "cnr")
-FLAVORS = ("wrapper", "serve", "crash", "repl")
+FLAVORS = ("wrapper", "serve", "crash", "repl", "sharded")
 
 #: canonical sizes — fixed per model so a sweep's cases share compiled
 #: kernels (same shapes => jit cache hits; per-case cost stays low)
@@ -123,6 +139,9 @@ class CaseSpec:
     #: FRESH rng stream so every pre-overlap schedule (and canary
     #: artifact) stays byte-identical
     overlap: int = 0
+    #: sharded flavor only: keyspace shard count (0 everywhere else,
+    #: so pre-sharding failing-seed artifacts keep replaying)
+    n_shards: int = 0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -217,9 +236,13 @@ def generate_case(
     rng = random.Random(int(seed))
     # the durability and replication planes are NR surfaces: with
     # "nr" filtered out, those flavors are dropped from the pool
-    # rather than silently overriding the wrapper filter
+    # rather than silently overriding the wrapper filter. "sharded"
+    # is excluded from the BASE pool so the original flavor draw (and
+    # every pre-sharding schedule) stays byte-identical — sharded
+    # cases come from the fresh-stream conversion below instead
     pool = [f for f in flavors
-            if "nr" in wrappers or f in ("wrapper", "serve")]
+            if f != "sharded"
+            and ("nr" in wrappers or f in ("wrapper", "serve"))]
     flavor = rng.choice(pool or ["wrapper"])
     if flavor in ("crash", "repl") or "cnr" not in wrappers:
         wrapper = "nr"
@@ -236,6 +259,17 @@ def generate_case(
     )
     R = 3 if with_corrupt else 2
     n = rng.randint(16, 36)
+    # keyspace sharding (ISSUE 18): a FRESH rng stream decides
+    # whether this seed becomes a sharded-fleet case — only serve/nr
+    # base cases convert (or every seed, under an explicit
+    # `flavors=("sharded",)` filter), and every draw the sharded
+    # schedule needs comes from the fresh stream, so non-converted
+    # seeds (and the canary expectations) stay byte-identical
+    if "sharded" in flavors and "nr" in wrappers:
+        srng = random.Random(int(seed) ^ 0x54A8D)
+        if not pool or (flavor == "serve" and wrapper == "nr"
+                        and srng.random() < 0.3):
+            return _generate_sharded(seed, srng, models)
     uniq = 1
     steps: list = []
 
@@ -394,6 +428,66 @@ def generate_case(
     return CaseSpec(seed, model, wrapper, flavor, R, nlogs, steps)
 
 
+def _generate_sharded(seed: int, srng: random.Random,
+                      models) -> CaseSpec:
+    """One sharded-fleet schedule, drawn ENTIRELY from the fresh
+    stream `srng` (the base stream's consumption up to the conversion
+    point is identical either way, so non-converted seeds replay
+    byte-identically). Keyed models only — `args[0]` is the routing
+    key, and stack/queue ops would degenerate onto one shard."""
+    keyed = [m for m in ("hashmap", "seqreg") if m in models]
+    model = srng.choice(keyed or ["hashmap"])
+    size = MODEL_SIZES[model]
+    n_shards = 2
+    uniq = 1
+    steps: list = []
+
+    def wop() -> list:
+        nonlocal uniq
+        op = _gen_write(srng, model, size, uniq)
+        uniq += 1
+        return op
+
+    for _ in range(srng.randint(18, 34)):
+        x = srng.random()
+        if x < 0.40:
+            steps.append(["sw", wop()])
+        elif x < 0.55:
+            steps.append(
+                ["sbatch", [wop() for _ in range(srng.randrange(2, 6))]]
+            )
+        elif x < 0.70:
+            steps.append(["sread", _gen_read(srng, model, size)])
+        elif x < 0.80:
+            steps.append(["swal", srng.randrange(n_shards)])
+        elif x < 0.90:
+            steps.append(["sship", srng.randrange(n_shards)])
+        else:
+            steps.append(["sapply", srng.randrange(n_shards)])
+    if srng.random() < 0.7:
+        # kill → typed-unavailability window (writes keyed into the
+        # victim's congruence class surface `ShardUnavailable`; the
+        # survivor keeps acking — the isolation half of the property)
+        # → promotion → router re-home → post-failover serving
+        victim = srng.randrange(n_shards)
+        steps.append(["swal", victim])
+        if srng.random() < 0.7:
+            steps.append(["sship", victim])
+        steps.append(["skill", victim])
+        for _ in range(srng.randrange(2, 5)):
+            steps.append(["sw", wop()])
+        steps.append(["spromote", victim])
+        if srng.random() < 0.5:
+            steps.append(["szombie", victim])
+        for _ in range(srng.randrange(2, 6)):
+            steps.append(["sw", wop()])
+    else:
+        for s in range(n_shards):
+            steps += [["swal", s], ["sship", s], ["sapply", s]]
+    return CaseSpec(seed, model, "nr", "sharded", 1, 1, steps,
+                    n_shards=n_shards)
+
+
 # ==========================================================================
 # interpretation
 # ==========================================================================
@@ -452,6 +546,17 @@ class _Run:
         self.promoted = False
         self.shipped_acked = 0
         self.pre_kill_cursor = 0
+        # sharded flavor: one primary stack per shard, behind the
+        # real router (filled by _build)
+        self.shards: list = []  # per-shard plumbing dicts
+        self.router = None
+        self.smap = None
+        self.sh_oracle: list = []  # per-shard oracle
+        self.sh_applied: list = []  # per-shard acked ops, in order
+        self.sh_dead: list = []
+        self.sh_promoted: list = []
+        self.sh_pre_cursor: list = []
+        self.sh_acked: list = []  # shipped-acked floor at kill time
 
     # ------------------------------------------------------------ plumbing
 
@@ -476,6 +581,9 @@ class _Run:
         from node_replication_tpu.core.replica import NodeReplicated
 
         spec = self.spec
+        if spec.flavor == "sharded":
+            self._build_sharded()
+            return
         if spec.wrapper == "cnr":
             self.wr = MultiLogReplicated(
                 self.dispatch, _key_mapper, nlogs=spec.nlogs,
@@ -567,7 +675,87 @@ class _Run:
             self.oracle_f = make_oracle(self.spec.model,
                                         MODEL_SIZES[self.spec.model])
 
+    def _build_sharded(self):
+        from node_replication_tpu.core.replica import NodeReplicated
+        from node_replication_tpu.durable.wal import WriteAheadLog
+        from node_replication_tpu.repl.feed import DirectoryFeed
+        from node_replication_tpu.repl.follower import Follower
+        from node_replication_tpu.repl.shipper import (
+            ReplicationShipper,
+        )
+        from node_replication_tpu.serve.frontend import (
+            ServeConfig,
+            ServeFrontend,
+        )
+        from node_replication_tpu.shard.ring import ShardMap
+        from node_replication_tpu.shard.router import (
+            LocalBackend,
+            ShardRouter,
+        )
+
+        spec = self.spec
+        self.tmp = tempfile.mkdtemp(prefix="nr-sim-")
+        self.smap = ShardMap(spec.n_shards)
+        backends: dict = {}
+        for s in range(spec.n_shards):
+            base = os.path.join(self.tmp, f"s{s}")
+            nr = NodeReplicated(
+                self.dispatch, n_replicas=1,
+                log_entries=LOG_ENTRIES, gc_slack=GC_SLACK,
+            )
+            wal = WriteAheadLog(
+                os.path.join(base, "wal"), policy="batch",
+                arg_width=self.dispatch.arg_width,
+                segment_max_bytes=REPL_SEGMENT_BYTES,
+            )
+            nr.attach_wal(wal)
+            feed = DirectoryFeed(
+                os.path.join(base, "feed"),
+                arg_width=self.dispatch.arg_width,
+            )
+            shipper = ReplicationShipper(wal, feed, auto_start=False)
+            fe = ServeFrontend(
+                nr,
+                ServeConfig(batch_linger_s=0.0, queue_depth=64,
+                            durability="batch"),
+            )
+            follower = Follower(
+                self.dispatch, feed,
+                directory=os.path.join(base, "flw"),
+                config=ServeConfig(durability="batch",
+                                   batch_linger_s=0.0),
+                auto_start=False,
+                nr_kwargs={"n_replicas": 1,
+                           "log_entries": LOG_ENTRIES,
+                           "gc_slack": GC_SLACK},
+            )
+            self.shards.append({"nr": nr, "wal": wal, "feed": feed,
+                                "shipper": shipper, "fe": fe,
+                                "follower": follower})
+            backends[s] = LocalBackend(s, fe, self.smap)
+            self.sh_oracle.append(
+                make_oracle(spec.model, MODEL_SIZES[spec.model])
+            )
+            self.sh_applied.append([])
+            self.sh_dead.append(False)
+            self.sh_promoted.append(False)
+            self.sh_pre_cursor.append(0)
+            self.sh_acked.append(0)
+        # sequential shard-ordered fan-out: the sim's determinism knob
+        self.router = ShardRouter(self.smap, backends,
+                                  concurrent=False)
+
     def _teardown(self):
+        for sh in self.shards:
+            try:
+                sh["fe"].close(drain=False)
+            except Exception:
+                pass
+            sh["follower"].close()
+            try:
+                sh["wal"].clear_pin(sh["shipper"].pin_name)
+            except Exception:
+                pass
         if self.fe is not None:
             self.fe.close()
         if self.mgr is not None:
@@ -1122,6 +1310,183 @@ class _Run:
         self.ev(i, "promote", applied=applied, epoch=epoch,
                 detected=detected)
 
+    # ------------------------------------------------------ sharded steps
+
+    def _shard_of(self, op: list) -> int:
+        return self.smap.shard_of_op(tuple(op))
+
+    def _fold_shard_ack(self, i: int, s: int, op: list,
+                        resp) -> None:
+        """Fold one router-acked op into shard `s`'s oracle. Keys are
+        disjoint across shards (the `key % N` congruence), so the
+        per-shard fold in submission order IS the global fold."""
+        expect = self.sh_oracle[s].apply(op)
+        self.sh_applied[s].append(list(op))
+        if int(resp) != int(expect):
+            self.vio("resp-diff", i,
+                     f"shard {s} op {op} -> {int(resp)}, oracle "
+                     f"{int(expect)}")
+
+    def do_sw(self, i: int, op: list) -> None:
+        s = self._shard_of(op)
+        try:
+            resp = self.router.call(tuple(op))
+        except Exception as e:  # typed routing/availability edges
+            self.ev(i, "sw-err", shard=s, err=type(e).__name__)
+            return
+        self._fold_shard_ack(i, s, op, resp)
+        self.ev(i, "sw", shard=s, resp=int(resp))
+
+    def do_sbatch(self, i: int, ops: list) -> None:
+        """One multi-shard batch through the router: per-op outcomes
+        (the CNR non-atomic cross-shard contract) — a dead shard's
+        slots error while the survivor's slots commit and must still
+        match the oracle."""
+        out = self.router.execute_batch(
+            [tuple(op) for op in ops], return_exceptions=True,
+        )
+        results: list = []
+        for op, r in zip(ops, out):
+            s = self._shard_of(op)
+            if isinstance(r, BaseException):
+                results.append([s, "err", type(r).__name__])
+                continue
+            self._fold_shard_ack(i, s, op, r)
+            results.append([s, "ok", int(r)])
+        self.ev(i, "sbatch", results=results)
+
+    def do_sread(self, i: int, op: list) -> None:
+        s = self._shard_of(op)
+        sh = self.shards[s]
+        fe = (sh["follower"].frontend if self.sh_promoted[s]
+              else sh["fe"])
+        try:
+            val = fe.read(tuple(op), rid=0)
+        except Exception as e:
+            self.ev(i, "sread-err", shard=s, err=type(e).__name__)
+            return
+        expect = self.sh_oracle[s].read(op)
+        if int(val) != int(expect):
+            self.vio("read-diff", i,
+                     f"shard {s} read {op} -> {int(val)}, oracle "
+                     f"{int(expect)}")
+        self.ev(i, "sread", shard=s, val=int(val))
+
+    def do_swal(self, i: int, s: int) -> None:
+        if self.sh_dead[s]:
+            self.ev(i, "swal-skip", shard=s)
+            return
+        pos = self.shards[s]["nr"].wal_sync()
+        self.ev(i, "swal", shard=s, durable=int(pos))
+
+    def do_sship(self, i: int, s: int, zombie: bool = False) -> None:
+        from node_replication_tpu.repl.feed import EpochFencedError
+
+        sh = self.shards[s]
+        if not zombie and (self.sh_dead[s] or self.sh_promoted[s]):
+            self.ev(i, "sship-skip", shard=s)
+            return
+        cur0 = int(sh["shipper"].cursor)
+        try:
+            sh["shipper"]._ship_once()
+        except EpochFencedError:
+            self.ev(i, "sship-fenced", shard=s)
+            return
+        except Exception as e:
+            self.vio("replication-gap", i,
+                     f"shard {s} ship failed: "
+                     f"{type(e).__name__}: {e}")
+            return
+        cur = int(sh["shipper"].cursor)
+        if zombie and cur > self.sh_pre_cursor[s]:
+            self.vio("zombie-unfenced", i,
+                     f"shard {s}'s superseded shipper published "
+                     f"{self.sh_pre_cursor[s]}->{cur} past the "
+                     f"promotion fence")
+        self.ev(i, "sship", shard=s, shipped=cur - cur0, cursor=cur)
+
+    def do_sapply(self, i: int, s: int) -> None:
+        sh = self.shards[s]
+        if self.sh_promoted[s]:
+            self.ev(i, "sapply-skip", shard=s)
+            return
+        try:
+            n = sh["follower"]._apply_once()
+        except Exception as e:
+            self.vio("replication-gap", i,
+                     f"shard {s} follower apply failed: "
+                     f"{type(e).__name__}: {e}")
+            return
+        ap = int(sh["follower"].applied_pos())
+        if ap > len(self.sh_applied[s]):
+            self.vio("replication-gap", i,
+                     f"shard {s} follower applied {ap} > acked "
+                     f"history {len(self.sh_applied[s])}")
+        self.ev(i, "sapply", shard=s, records=int(n), applied=ap)
+
+    def do_skill(self, i: int, s: int) -> None:
+        sh = self.shards[s]
+        if self.sh_dead[s]:
+            self.ev(i, "skill-skip", shard=s)
+            return
+        sh["fe"].close(drain=True)
+        self.sh_dead[s] = True
+        self.sh_pre_cursor[s] = int(sh["shipper"].cursor)
+        self.sh_acked[s] = min(int(sh["wal"].durable_tail),
+                               self.sh_pre_cursor[s])
+        self.ev(i, "skill", shard=s,
+                durable=int(sh["wal"].durable_tail),
+                shipped=self.sh_pre_cursor[s],
+                acked=self.sh_acked[s])
+
+    def do_spromote(self, i: int, s: int) -> None:
+        from node_replication_tpu.shard.router import LocalBackend
+
+        sh = self.shards[s]
+        if self.sh_promoted[s]:
+            self.ev(i, "spromote-skip", shard=s)
+            return
+        try:
+            rep = sh["follower"].promote()
+            applied = int(rep["applied"])
+            epoch = int(rep["epoch"])
+        except Exception as e:
+            self.vio("replication-gap", i,
+                     f"shard {s} promotion failed: "
+                     f"{type(e).__name__}: {e}")
+            return
+        self.sh_promoted[s] = True
+        self.sh_dead[s] = True
+        if applied < self.sh_acked[s]:
+            self.vio("durable-ack-survival", i,
+                     f"shard {s} promoted at {applied} < "
+                     f"shipped-acked {self.sh_acked[s]}")
+        if applied > len(self.sh_applied[s]):
+            self.vio("replication-gap", i,
+                     f"shard {s} promoted at {applied} > acked "
+                     f"history {len(self.sh_applied[s])}")
+            applied = len(self.sh_applied[s])
+        # the follower's history is now the authority for this
+        # shard's slice; the dead primary's unshipped suffix is
+        # legally gone — truncate and refold the per-shard oracle
+        self.sh_applied[s] = self.sh_applied[s][:applied]
+        self.sh_oracle[s] = make_oracle(
+            self.spec.model, MODEL_SIZES[self.spec.model]
+        )
+        for op in self.sh_applied[s]:
+            self.sh_oracle[s].apply(op)
+        # re-home the router: re-publish the bumped map and point the
+        # victim's slot at the promoted follower's frontend — the
+        # other shards' backends never change (isolation)
+        new_map = self.smap.with_address(s, None)
+        self.router.repoint(
+            s, LocalBackend(s, sh["follower"].frontend, new_map),
+            new_map=new_map,
+        )
+        self.smap = new_map
+        self.ev(i, "spromote", shard=s, applied=applied, epoch=epoch,
+                map_version=int(new_map.version))
+
     # ---------------------------------------------------------- end state
 
     def _check_arrays(self, state, oracle, i: int,
@@ -1171,8 +1536,70 @@ class _Run:
                          f"log[{k}] = {got} != acked {want}")
                 return
 
+    def _check_shard_slice(self, nr, s: int, i: int) -> None:
+        """Every key in shard `s`'s ring must be ≡ s (mod N) — an op
+        leaked into the wrong shard's keyspace slice is the
+        fleet-level routing invariant breaking, named directly."""
+        from node_replication_tpu.core.log import ring_slice
+
+        tail = int(np.asarray(nr.log.tail))
+        if tail == 0:
+            return
+        _opcodes, args = ring_slice(nr.spec, nr.log, 0, tail)
+        for k in range(tail):
+            key = int(args[k][0])
+            if key % self.spec.n_shards != s:
+                self.vio("shard-isolation", i,
+                         f"shard {s} log[{k}] holds key {key} "
+                         f"(owner shard "
+                         f"{key % self.spec.n_shards})")
+                return
+
+    def _finalize_sharded(self) -> None:
+        for s in range(self.spec.n_shards):
+            sh = self.shards[s]
+            if self.sh_promoted[s]:
+                nr = sh["follower"].nr
+                nr.sync()
+                self._check_shard_slice(nr, s, -1)
+                self._check_arrays(nr.verify(lambda st: st),
+                                   self.sh_oracle[s], -1,
+                                   prop="shard-isolation")
+                self._check_ring(nr, self.sh_applied[s], -1)
+                continue
+            if not self.sh_dead[s]:
+                sh["fe"].close()
+            nr = sh["nr"]
+            nr.sync()
+            self._check_shard_slice(nr, s, -1)
+            self._check_arrays(nr.verify(lambda st: st),
+                               self.sh_oracle[s], -1,
+                               prop="shard-isolation")
+            self._check_ring(nr, self.sh_applied[s], -1)
+            # the follower's state must be a PREFIX fold of exactly
+            # this shard's acked ops (no lost/dup/foreign records)
+            ap = int(sh["follower"].applied_pos())
+            if ap > len(self.sh_applied[s]):
+                self.vio("replication-gap", -1,
+                         f"shard {s} follower applied {ap} > acked "
+                         f"history {len(self.sh_applied[s])}")
+                continue
+            f_oracle = make_oracle(self.spec.model,
+                                   MODEL_SIZES[self.spec.model])
+            for op in self.sh_applied[s][:ap]:
+                f_oracle.apply(op)
+            fnr = sh["follower"].nr
+            fnr.sync()
+            self._check_shard_slice(fnr, s, -1)
+            self._check_arrays(fnr.verify(lambda st: st),
+                               f_oracle, -1, prop="shard-isolation")
+            self._check_ring(fnr, self.sh_applied[s][:ap], -1)
+
     def finalize(self) -> None:
         spec = self.spec
+        if spec.flavor == "sharded":
+            self._finalize_sharded()
+            return
         if spec.flavor == "repl":
             if not self.promoted and not self.primary_dead:
                 # drain: finish shipping/applying what is already
@@ -1249,7 +1676,7 @@ def run_case(spec: CaseSpec) -> CaseResult:
                 elif kind == "probe":
                     run.do_probe(i)
                 elif kind == "sync":
-                    if not run.primary_dead:
+                    if run.wr is not None and not run.primary_dead:
                         run.wr.sync()
                     run.ev(i, "sync")
                 elif kind == "wal-sync":
@@ -1279,6 +1706,24 @@ def run_case(spec: CaseSpec) -> CaseResult:
                     run.do_kill(i)
                 elif kind == "promote":
                     run.do_promote(i)
+                elif kind == "sw":
+                    run.do_sw(i, list(step[1]))
+                elif kind == "sbatch":
+                    run.do_sbatch(i, [list(o) for o in step[1]])
+                elif kind == "sread":
+                    run.do_sread(i, list(step[1]))
+                elif kind == "swal":
+                    run.do_swal(i, int(step[1]))
+                elif kind == "sship":
+                    run.do_sship(i, int(step[1]))
+                elif kind == "sapply":
+                    run.do_sapply(i, int(step[1]))
+                elif kind == "skill":
+                    run.do_skill(i, int(step[1]))
+                elif kind == "spromote":
+                    run.do_spromote(i, int(step[1]))
+                elif kind == "szombie":
+                    run.do_sship(i, int(step[1]), zombie=True)
                 else:
                     raise ValueError(f"unknown step kind {kind!r}")
             run.finalize()

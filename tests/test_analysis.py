@@ -1572,3 +1572,60 @@ class TestUnnamedWorkerThread:
                 return threading.Thread(target=fn)
         """)
         assert not firing(diags, "unnamed-worker-thread")
+
+
+class TestUnroutedKeyInShardPath:
+    def _lint_in(self, tmp_path, subdir, source):
+        import textwrap
+        d = tmp_path / subdir
+        d.mkdir(parents=True, exist_ok=True)
+        p = d / "snippet.py"
+        p.write_text(textwrap.dedent(source))
+        diags, errors = run_lint([str(p)])
+        assert not errors, errors
+        return diags
+
+    def test_unrouted_submit_fires(self, tmp_path):
+        # the mis-route pattern: a shard/ helper that hands ops to a
+        # frontend with no ShardMap lookup anywhere in the function —
+        # one stale-map refactor away from writing into the wrong
+        # keyspace slice
+        diags = self._lint_in(tmp_path, "shard", """
+            class Proxy:
+                def forward(self, fe, ops):
+                    futs = [fe.submit(op) for op in ops]
+                    return [f.result() for f in futs]
+
+                def forward_round(self, nr, opcodes, args):
+                    return nr.execute_mut_batch(opcodes, args)
+        """)
+        assert len(firing(diags, "unrouted-key-in-shard-path")) == 2
+
+    def test_routed_submit_clean(self, tmp_path):
+        # the sanctioned shape (shard/router.py LocalBackend): the
+        # same function re-verifies each op's owner through the map
+        # before staging anything
+        diags = self._lint_in(tmp_path, "shard", """
+            class Backend:
+                def submit_batch(self, fe, ops):
+                    for op in ops:
+                        if self._map.shard_of_op(op) != self.shard:
+                            raise ValueError("wrong shard")
+                    return [fe.submit(op) for op in ops]
+
+                def route(self, fe, ops):
+                    groups = self._map.split_batch(ops)
+                    for shard, entries in groups.items():
+                        for _i, op in entries:
+                            fe.submit(op)
+        """)
+        assert not firing(diags, "unrouted-key-in-shard-path")
+
+    def test_outside_shard_clean(self, tmp_path):
+        # the serve plane itself has no sharding contract to honor
+        diags = self._lint_in(tmp_path, "serve", """
+            class Caller:
+                def call(self, fe, op):
+                    return fe.submit(op).result()
+        """)
+        assert not firing(diags, "unrouted-key-in-shard-path")

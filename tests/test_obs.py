@@ -423,6 +423,51 @@ class TestReportCLI:
         assert data["stalls"][0]["where"] == "sync"
         assert data["stalls"][0]["dormant"] == [1]
 
+    def test_sharding_section(self, tmp_path, capsys):
+        # a sharded fleet's trace renders the Sharding section: map
+        # adoptions with the re-homed slices, refusals by typed error
+        from node_replication_tpu.obs import report
+
+        path = tmp_path / "trace.jsonl"
+        t = get_tracer()
+        t.enable(str(path))
+        try:
+            t.emit("serve-reroute", reason="promotion",
+                   map_version=2, from_version=1, shards=[0])
+            t.emit("shard-refused", shard=1, error="WrongShard",
+                   detail="stale HELLO v1")
+            t.emit("shard-refused", shard=1, error="WrongShard",
+                   detail="stale HELLO v1")
+        finally:
+            t.disable()
+        assert report.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "== sharding ==" in out
+        assert ("map adoptions: 1 (final version 2)   "
+                "refused submits: 2") in out
+        assert "[promotion]: v1 -> v2, re-homed: s0" in out
+        assert "refusals by error: WrongShard=2" in out
+        assert "refusals by shard: s1=2" in out
+
+    def test_sharding_section_json(self, tmp_path, capsys):
+        from node_replication_tpu.obs import report
+
+        path = tmp_path / "trace.jsonl"
+        t = get_tracer()
+        t.enable(str(path))
+        try:
+            t.emit("serve-reroute", reason="adopt", map_version=3,
+                   from_version=2, shards=[0, 2])
+        finally:
+            t.disable()
+        assert report.main([str(path), "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        shd = data["sharding"]
+        assert shd["map_adoptions"] == 1
+        assert shd["final_map_version"] == 3
+        assert shd["adoptions"][0]["shards"] == [0, 2]
+        assert shd["refused"] == 0
+
     def test_mesh_section(self, tmp_path, capsys):
         # a mesh-sharded fleet's trace renders the Mesh section:
         # placement, rounds by collective tier, sync bytes, ring passes

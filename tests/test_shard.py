@@ -1,0 +1,310 @@
+"""Keyspace-sharded primary fleet (ISSUE 18): the `ShardMap`
+congruence contract, router split/fan-out/reassembly, typed
+`WrongShard` / `ShardUnavailable` semantics, the TCP submit path with
+HELLO version fencing on every reconnect, and the `ShardGroup`
+kill → promote → re-home story with `call_with_retry` re-routing.
+
+The contract under test: shard `s` of `N` owns every key `k` with
+`k % N == s` (an op's key is `args[0]`); the router reassembles
+responses in submission order; cross-shard batches are explicitly
+NOT atomic (per-op outcomes, no rollback); a mis-routed or
+stale-version submit is a typed `WrongShard` BEFORE any log effect;
+a dead shard is a retryable `ShardUnavailable` (maybe_executed=False)
+that only that keyspace slice observes; and a promotion bumps +
+re-publishes the map so a zombie peer can never ack.
+"""
+
+import os
+import threading
+
+import pytest
+
+from node_replication_tpu.models import (
+    HM_GET,
+    HM_PUT,
+    make_hashmap,
+)
+from node_replication_tpu.serve import (
+    RetryPolicy,
+    ServeConfig,
+    ServeFrontend,
+    ShardUnavailable,
+    WrongShard,
+    call_with_retry,
+)
+from node_replication_tpu.shard import (
+    MAP_FILENAME,
+    LocalBackend,
+    ShardGroup,
+    ShardMap,
+    ShardRouter,
+    ShardServer,
+    SocketShardClient,
+)
+
+NR_KW = dict(n_replicas=1, log_entries=1 << 10, gc_slack=32)
+
+
+def _frontend(n_keys=64):
+    from node_replication_tpu.core.replica import NodeReplicated
+
+    nr = NodeReplicated(make_hashmap(n_keys), **NR_KW)
+    return ServeFrontend(nr, ServeConfig(batch_linger_s=0.0))
+
+
+# ==========================================================================
+# ShardMap
+# ==========================================================================
+
+
+class TestShardMap:
+    def test_congruence_routing_is_deterministic(self):
+        m = ShardMap(3)
+        for k in range(30):
+            assert m.shard_of(k) == k % 3
+            assert m.shard_of_op((HM_PUT, k, 1)) == k % 3
+
+    def test_split_batch_preserves_submission_indices(self):
+        m = ShardMap(2)
+        ops = [(HM_PUT, k, 100 + k) for k in (0, 1, 2, 5, 4)]
+        groups = m.split_batch(ops)
+        assert sorted(groups) == [0, 1]
+        assert [i for i, _ in groups[0]] == [0, 2, 4]
+        assert [i for i, _ in groups[1]] == [1, 3]
+        # within a shard, submission order is preserved
+        assert [op[1] for _, op in groups[0]] == [0, 2, 4]
+
+    def test_opless_key_rejected(self):
+        with pytest.raises(ValueError):
+            ShardMap(2).shard_of_op((HM_GET,))
+
+    def test_with_address_bumps_version(self):
+        m = ShardMap(2)
+        m2 = m.with_address(1, ("127.0.0.1", 9))
+        assert m2.version == m.version + 1
+        assert m2.addresses[1] == ("127.0.0.1", 9)
+        assert m2.addresses[0] is None
+        assert m.addresses[1] is None  # immutable original
+
+    def test_publish_load_roundtrip(self, tmp_path):
+        m = ShardMap(3).with_address(2, ("h", 7))
+        m.publish(str(tmp_path))
+        assert os.path.exists(tmp_path / MAP_FILENAME)
+        assert ShardMap.load(str(tmp_path)) == m
+
+    def test_invalid_maps_rejected(self):
+        with pytest.raises(ValueError):
+            ShardMap(0)
+        with pytest.raises(ValueError):
+            ShardMap(2, addresses=(None,))
+
+
+# ==========================================================================
+# router over local backends
+# ==========================================================================
+
+
+class TestRouterLocal:
+    @pytest.fixture
+    def fleet(self):
+        m = ShardMap(2)
+        fes = [_frontend(), _frontend()]
+        router = ShardRouter(
+            m, {s: LocalBackend(s, fes[s], m) for s in range(2)}
+        )
+        yield m, fes, router
+        router.close()
+        for fe in fes:
+            fe.close()
+
+    def test_batch_routes_and_orders_within_shard(self, fleet):
+        _m, fes, router = fleet
+        # one mixed batch, including a same-key rewrite: each op must
+        # land on its owning shard, in submission order (last write
+        # wins within the congruence class)
+        ops = [(HM_PUT, k, 100 + k) for k in range(8)]
+        ops.append((HM_PUT, 3, 999))
+        out = router.execute_batch(ops)
+        assert len(out) == 9
+        for k in range(8):
+            want = 999 if k == 3 else 100 + k
+            got = fes[k % 2].read((HM_GET, k, 0), rid=0)
+            assert int(got) == want
+
+    def test_ops_land_on_owning_shard_only(self, fleet):
+        _m, fes, router = fleet
+        router.execute_batch([(HM_PUT, k, 1) for k in range(6)])
+        import numpy as np
+
+        # each frontend's log holds exactly its congruence class
+        for s, fe in enumerate(fes):
+            assert int(np.asarray(fe.nr.log.tail)) == 3
+
+    def test_misrouted_op_is_typed_wrong_shard(self, fleet):
+        m, fes, _router = fleet
+        b = LocalBackend(0, fes[0], m)
+        with pytest.raises(WrongShard) as ei:
+            b.submit_batch([(HM_PUT, 1, 5)], m.version)
+        assert ei.value.key == 1 and ei.value.expected_shard == 1
+        # and provably no log effect
+        import numpy as np
+
+        assert int(np.asarray(fes[0].nr.log.tail)) == 0
+
+    def test_stale_version_is_wrong_shard(self, fleet):
+        m, fes, _router = fleet
+        b = LocalBackend(0, fes[0], m)
+        b.update_version(m.with_address(0, None))  # now at version 2
+        with pytest.raises(WrongShard) as ei:
+            b.submit_batch([(HM_PUT, 0, 5)], m.version)
+        assert ei.value.peer_version == m.version
+
+    def test_cross_shard_batch_not_atomic(self, fleet):
+        _m, fes, router = fleet
+        fes[0].close(drain=False)  # shard 0 down
+        ops = [(HM_PUT, 0, 7), (HM_PUT, 1, 8)]
+        out = router.execute_batch(ops, return_exceptions=True)
+        assert isinstance(out[0], ShardUnavailable)
+        assert out[0].retryable  # never reached the log
+        assert int(out[1]) >= 0  # shard 1 committed independently
+        assert int(fes[1].read((HM_GET, 1, 0), rid=0)) == 8
+
+    def test_sequential_fanout_matches_concurrent(self):
+        m = ShardMap(2)
+        fes = [_frontend(), _frontend()]
+        router = ShardRouter(
+            m, {s: LocalBackend(s, fes[s], m) for s in range(2)},
+            concurrent=False,
+        )
+        try:
+            ops = [(HM_PUT, k, 50 + k) for k in range(6)]
+            router.execute_batch(ops)
+            for k in range(6):
+                got = fes[k % 2].read((HM_GET, k, 0), rid=0)
+                assert int(got) == 50 + k
+        finally:
+            router.close()
+            for fe in fes:
+                fe.close()
+
+
+# ==========================================================================
+# the TCP submit path
+# ==========================================================================
+
+
+class TestSocketPath:
+    @pytest.fixture
+    def served(self):
+        m = ShardMap(2)
+        fes = [_frontend(), _frontend()]
+        servers = [
+            ShardServer(s, fes[s], m, name="t") for s in range(2)
+        ]
+        clients = {
+            s: SocketShardClient(
+                s, (servers[s].host, servers[s].port), m.version
+            )
+            for s in range(2)
+        }
+        router = ShardRouter(m, clients)
+        yield m, fes, servers, router, clients
+        router.close()
+        for srv in servers:
+            srv.close()
+        for fe in fes:
+            fe.close()
+
+    def test_roundtrip_over_frames(self, served):
+        _m, fes, _servers, router, _clients = served
+        router.execute_batch([(HM_PUT, k, 10 + k) for k in range(4)])
+        for k in range(4):
+            got = fes[k % 2].read((HM_GET, k, 0), rid=0)
+            assert int(got) == 10 + k
+
+    def test_typed_errors_survive_the_wire(self, served):
+        m, _fes, _servers, _router, clients = served
+        with pytest.raises(WrongShard) as ei:
+            clients[0].submit_batch([(HM_PUT, 1, 5)], m.version)
+        assert ei.value.key == 1 and ei.value.expected_shard == 1
+
+    def test_stale_hello_fenced_on_reconnect(self, served):
+        m, _fes, servers, _router, clients = served
+        # the shard adopts a bumped map; a client that reconnects
+        # under the old version must be refused at HELLO — the
+        # routing-tier zombie fence
+        servers[0].set_map(m.with_address(0, None))
+        clients[0].close()  # force a fresh connect + HELLO replay
+        with pytest.raises(WrongShard):
+            clients[0].submit_batch([(HM_PUT, 0, 1)], m.version)
+
+    def test_dead_server_is_retryable_unavailable(self, served):
+        m, _fes, servers, _router, clients = served
+        servers[0].close()
+        clients[0].close()
+        with pytest.raises(ShardUnavailable) as ei:
+            clients[0].submit_batch([(HM_PUT, 0, 1)], m.version)
+        assert not ei.value.maybe_executed
+
+
+# ==========================================================================
+# ShardGroup: kill one slice, promote, re-home
+# ==========================================================================
+
+
+class TestShardGroup:
+    def test_kill_promote_rehome(self, tmp_path):
+        g = ShardGroup(2, make_hashmap(64), str(tmp_path), nr_kwargs=NR_KW)
+        try:
+            r = g.router
+            r.execute_batch([(HM_PUT, k, 100 + k) for k in range(8)])
+            g.kill_primary(0)
+            # the failed slice is typed-unavailable and retryable...
+            with pytest.raises(ShardUnavailable) as ei:
+                r.call((HM_PUT, 0, 1))
+            assert ei.value.retryable
+            # ...while the surviving shard never notices
+            assert int(r.call((HM_PUT, 1, 201))) >= 0
+            fe1 = g.primaries[1].live_frontend
+            assert int(fe1.read((HM_GET, 1, 0), rid=0)) == 201
+            report = g.promote(0)
+            assert report.new_epoch >= 1
+            # re-home: bumped map re-published, router repointed, the
+            # promoted follower serves the slice with acked history
+            assert ShardMap.load(str(tmp_path)).version == 2
+            fe0 = g.primaries[0].live_frontend
+            assert int(fe0.read((HM_GET, 0, 0), rid=0)) == 100
+            assert int(r.call((HM_PUT, 0, 300))) >= 0
+            assert int(fe0.read((HM_GET, 0, 0), rid=0)) == 300
+        finally:
+            g.close()
+
+    def test_call_with_retry_rides_through_promotion(self, tmp_path):
+        g = ShardGroup(2, make_hashmap(64), str(tmp_path), nr_kwargs=NR_KW)
+        try:
+            r = g.router
+            call_with_retry(r, (HM_PUT, 0, 5), policy=RetryPolicy())
+            g.kill_primary(0)
+            done = threading.Event()
+
+            def promote_later():
+                g.promote(0)
+                done.set()
+
+            t = threading.Thread(target=promote_later,
+                                 name="test-shard-promoter")
+            t.start()
+            # retries absorb the outage window; the resubmission
+            # re-homes onto the promoted follower via refresh_map
+            val = call_with_retry(
+                r, (HM_PUT, 0, 6),
+                policy=RetryPolicy(max_attempts=40, base_backoff_s=0.05),
+                deadline_s=30.0,
+            )
+            t.join(timeout=10)
+            assert done.is_set()
+            assert int(val) >= 0
+            fe0 = g.primaries[0].live_frontend
+            assert int(fe0.read((HM_GET, 0, 0), rid=0)) == 6
+        finally:
+            g.close()
