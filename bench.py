@@ -2833,6 +2833,570 @@ def sharded_main(args) -> int:
     return 0
 
 
+# ==========================================================================
+# --txn / --reshard: the cross-shard atomicity + online-split gates
+# ==========================================================================
+
+#: txn bench values live above this floor so the WAL exactly-once scan
+#: can tell transactional writes from preload/background traffic
+_TXN_VAL_BASE = 1_000_000
+
+_TXN_NR_KW = dict(n_replicas=1, log_entries=1 << 12, gc_slack=64,
+                  exec_window=128)
+
+
+def _txn_group(base: str, keys: int, recover: bool = False,
+               with_txn: bool = True, with_followers: bool = False):
+    from node_replication_tpu.shard.primary import ShardGroup
+    return ShardGroup(
+        2, make_hashmap(keys), base,
+        nr_kwargs=_TXN_NR_KW,
+        with_followers=with_followers,
+        with_txn=with_txn,
+        recover=recover,
+        concurrent_router=False,
+    )
+
+
+def txn_child_main(args) -> int:
+    """`--txn-child` (internal): the crash victim of ONE `--txn` kill
+    round. Builds a 2-shard `ShardGroup` + `TxnCoordinator` in
+    `--txn-dir`, arms a REAL SIGKILL (`FaultSpec(action="kill")`) at
+    the requested txn fault site, then drives cross-shard
+    transactions flat-out, fsyncing each ACKED txn's ops to
+    `acked.jsonl` — the parent's ground truth for the
+    zero-half-committed read-back. The expected exit is the SIGKILL
+    itself; exit 3 means the armed kill never fired (a parent-side
+    round failure), exit 0 is the unkilled calibration run."""
+    import os
+
+    from node_replication_tpu.fault.inject import FaultPlan, FaultSpec
+
+    g = _txn_group(args.txn_dir, args.txn_keys)
+    coord = g.coordinator(name="bench")
+    if args.txn_kill_site != "none":
+        FaultPlan([FaultSpec(site=args.txn_kill_site, action="kill",
+                             rid=-1, after=args.txn_kill_after)],
+                  seed=args.seed).arm()
+    acked = open(os.path.join(args.txn_dir, "acked.jsonl"), "a")
+    k = 0
+    for i in range(args.txn_count):
+        # k and k+1 differ mod 2 -> every txn spans both shards; keys
+        # strictly increase so each is written exactly once ever and
+        # an aborted txn's keys must read back absent (-1)
+        ops = [(HM_PUT, k, _TXN_VAL_BASE + k),
+               (HM_PUT, k + 1, _TXN_VAL_BASE + k + 1)]
+        if i % 3 == 0:
+            ops.append((HM_PUT, k + 2, _TXN_VAL_BASE + k + 2))
+        k += len(ops)
+        coord.execute_txn([tuple(op) for op in ops])
+        acked.write(json.dumps({"ops": [list(o) for o in ops]}) + "\n")
+        acked.flush()
+        os.fsync(acked.fileno())
+    acked.close()
+    g.close()
+    return 3 if args.txn_kill_site != "none" else 0
+
+
+def txn_main(args) -> int:
+    """`--txn`: the crash-proof cross-shard transaction gate (ISSUE
+    20). Two legs:
+
+    - **SIGKILL matrix**: `--txn-rounds` child processes each drive
+      cross-shard 2PC transactions and die by a REAL `SIGKILL`
+      injected at a seeded point inside one of the three crash
+      windows — `txn-prepare` (coordinator mid-prepare: some
+      participants voted yes, no decision), `txn-commit` (participant
+      mid-commit: ops applied, resolved record missing), `txn-decide`
+      (decision durable, phase 2 not started). The parent then
+      restarts the fleet in place (`recover=True`), bumps the
+      coordinator epoch, re-drives published commit decisions, runs
+      every participant's in-doubt resolution, and hard-gates: every
+      acked txn fully visible by per-key read-back, every in-doubt
+      intent resolved to its durable decision (absence => presumed
+      abort, zero visible effect), ZERO half-committed multi-key ops,
+      and a WAL scan proving no txn write was applied twice.
+    - **parity**: non-txn single-shard throughput on a `with_txn`
+      fleet vs a txn-free build, alternating slices — 2PC must cost
+      nothing unused (`--txn-parity-min`, default 0.9).
+    """
+    import os
+    import random
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import time
+
+    from node_replication_tpu.harness.mkbench import (
+        append_sharded_csv,
+        txn_rows,
+    )
+
+    t_start = time.monotonic()
+    base = args.txn_dir or tempfile.mkdtemp(prefix="nr-txn-")
+    os.makedirs(base, exist_ok=True)
+    failures: list[str] = []
+    rng = random.Random(args.seed)
+    T = args.txn_count
+    sites = ["txn-prepare", "txn-commit", "txn-decide"]
+    # site-wide fault hits per driven txn: prepare fires at the
+    # participant AND after each coordinator leg (2 shards -> 4),
+    # commit once per participant, decide once per txn
+    per_txn = {"txn-prepare": 4, "txn-commit": 2, "txn-decide": 1}
+    acked_total = in_doubt_total = resolved_total = 0
+    half_committed = duplicated = 0
+    kills = 0
+
+    for r in range(args.txn_rounds):
+        site = sites[r % len(sites)]
+        rdir = os.path.join(base, f"round{r}")
+        shutil.rmtree(rdir, ignore_errors=True)
+        os.makedirs(rdir)
+        after = rng.randrange(per_txn[site] * (T // 4),
+                              per_txn[site] * (3 * T // 4))
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--txn-child", "--txn-dir", rdir,
+            "--txn-kill-site", site,
+            "--txn-kill-after", str(after),
+            "--txn-count", str(T),
+            "--txn-keys", str(args.txn_keys),
+            "--seed", str(args.seed + r),
+        ]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            proc = subprocess.run(cmd, env=env,
+                                  timeout=args.txn_timeout,
+                                  stdout=subprocess.DEVNULL)
+        except subprocess.TimeoutExpired:
+            failures.append(f"round {r}: child hung past "
+                            f"{args.txn_timeout}s ({site})")
+            continue
+        if proc.returncode != -signal.SIGKILL:
+            failures.append(
+                f"round {r}: child exited {proc.returncode}, expected "
+                f"death by SIGKILL at {site} hit {after}"
+            )
+            continue
+        kills += 1
+
+        # restart-in-place over the dead fleet's artifacts
+        g = _txn_group(rdir, args.txn_keys, recover=True)
+        try:
+            pre: dict[str, dict[int, list]] = {}
+            for p in g.primaries:
+                for txn, info in p.txn.log.unresolved().items():
+                    pre.setdefault(txn, {})[p.txn.shard] = [
+                        tuple(op) for op in info["ops"]
+                    ]
+            in_doubt = sum(len(v) for v in pre.values())
+            in_doubt_total += in_doubt
+            # a NEW coordinator generation (durable epoch bump) makes
+            # the dead one's undecided intents presumed-abortable,
+            # then published commits are re-driven and every
+            # participant resolves against the decision log
+            coord2 = g.coordinator(name="recover")
+            coord2.recover()
+            g.resolve_in_doubt()
+            remaining = 0
+            for p in g.primaries:
+                left = p.txn.log.unresolved()
+                remaining += len(left)
+                if left:
+                    failures.append(
+                        f"round {r}: shard {p.txn.shard} still in "
+                        f"doubt after recovery: {sorted(left)}"
+                    )
+                if p.txn.has_locks():
+                    failures.append(
+                        f"round {r}: shard {p.txn.shard} holds txn "
+                        f"locks after recovery"
+                    )
+            resolved_total += in_doubt - remaining
+
+            def _read(k: int) -> int:
+                s = g.map.shard_of(k)
+                return int(g.primaries[s].live_frontend.read(
+                    (HM_GET, k)))
+
+            # gate: every ACKED txn is fully visible after restart
+            acked_path = os.path.join(rdir, "acked.jsonl")
+            n_acked = 0
+            if os.path.exists(acked_path):
+                with open(acked_path) as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        ops = json.loads(line)["ops"]
+                        n_acked += 1
+                        gone = [(k, v) for _c, k, v in ops
+                                if _read(k) != v]
+                        if gone:
+                            half_committed += 1
+                            failures.append(
+                                f"round {r}: acked txn lost writes "
+                                f"{gone}"
+                            )
+            acked_total += n_acked
+            if n_acked == 0:
+                failures.append(
+                    f"round {r}: zero txns acked before the kill "
+                    f"(site {site} hit {after} fired too early to "
+                    f"exercise the matrix)"
+                )
+
+            # gate: every in-doubt txn is all-or-nothing per its
+            # durable decision (absence == presumed abort)
+            for txn, per_shard in sorted(pre.items()):
+                outcome = g.decisions.outcome(txn) or "abort"
+                flat = [op for s in sorted(per_shard)
+                        for op in per_shard[s]]
+                vis = sum(1 for _c, k, v in flat if _read(k) == v)
+                if outcome == "commit" and vis != len(flat):
+                    half_committed += 1
+                    failures.append(
+                        f"round {r}: committed txn {txn} applied "
+                        f"{vis}/{len(flat)} journaled ops"
+                    )
+                elif outcome == "abort" and vis:
+                    half_committed += 1
+                    failures.append(
+                        f"round {r}: aborted txn {txn} left "
+                        f"{vis}/{len(flat)} ops visible"
+                    )
+
+            # gate: exactly-once — no txn write appended twice across
+            # the crash + re-driven commit (the commit-begin dedup)
+            for p in g.primaries:
+                seen: set[tuple[int, int]] = set()
+                for rec in p.wal.records(p.wal.base):
+                    for op in rec.ops():
+                        if (int(op[0]) != HM_PUT
+                                or int(op[2]) < _TXN_VAL_BASE):
+                            continue
+                        pair = (int(op[1]), int(op[2]))
+                        if pair in seen:
+                            duplicated += 1
+                            failures.append(
+                                f"round {r}: shard {p.txn.shard} "
+                                f"applied {pair} twice"
+                            )
+                        seen.add(pair)
+        finally:
+            g.close()
+
+    # ------------------------------------------------------ parity leg
+    groups = {}
+    ops_done = {True: 0, False: 0}
+    for cfg in (True, False):
+        d = os.path.join(base, f"parity-{int(cfg)}")
+        shutil.rmtree(d, ignore_errors=True)
+        groups[cfg] = _txn_group(d, args.txn_keys, with_txn=cfg)
+    try:
+        slice_s = args.txn_parity_seconds / 6.0
+        for _ in range(3):
+            # alternate short slices so machine drift hits both
+            # configurations evenly
+            for cfg in (True, False):
+                g = groups[cfg]
+                n = ops_done[cfg]
+                end = time.monotonic() + slice_s
+                while time.monotonic() < end:
+                    # even keys: single-shard, never the txn path
+                    g.router.call((HM_PUT, (n % 64) * 2, n))
+                    n += 1
+                ops_done[cfg] = n
+    finally:
+        for g in groups.values():
+            g.close()
+    parity = (ops_done[True] / ops_done[False]
+              if ops_done[False] else 0.0)
+    if parity < args.txn_parity_min:
+        failures.append(
+            f"with_txn fleet served {ops_done[True]} non-txn ops vs "
+            f"{ops_done[False]} txn-free ({parity:.3f}x, gate "
+            f"{args.txn_parity_min})"
+        )
+
+    run = {
+        "n_shards": 2,
+        "clients": 1,
+        "duration": time.monotonic() - t_start,
+        "acked": acked_total,
+        "lost": half_committed,
+        "duplicated": duplicated,
+        "txn_rounds": args.txn_rounds,
+        "txn_acked": acked_total,
+        "txn_in_doubt": in_doubt_total,
+        "txn_resolved": resolved_total,
+        "txn_half_committed": half_committed,
+        "txn_parity": parity,
+    }
+    append_sharded_csv(args.serve_out, txn_rows("bench", run))
+    print(json.dumps({
+        "metric": "txn_half_committed",
+        "value": half_committed,
+        "unit": "txns",
+        "rounds": args.txn_rounds,
+        "kills": kills,
+        "txns_per_round": T,
+        "acked": acked_total,
+        "in_doubt": in_doubt_total,
+        "resolved": resolved_total,
+        "duplicated": duplicated,
+        "parity": round(parity, 3),
+    }))
+    if not args.txn_dir:
+        shutil.rmtree(base, ignore_errors=True)
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# txn OK: {kills}/{args.txn_rounds} SIGKILL rounds across "
+        f"prepare/commit/decide windows; {acked_total} acked txns "
+        f"intact, {in_doubt_total} in-doubt intents resolved "
+        f"({resolved_total} resolutions), 0 half-committed, 0 "
+        f"double-applied; non-txn parity {parity:.3f}x "
+        f"(gate {args.txn_parity_min})",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def reshard_main(args) -> int:
+    """`--reshard`: the online keyspace-split gate (ISSUE 20). A
+    2-shard `ShardGroup` serves closed-loop per-key writers (one
+    thread per congruence class mod 4, monotone values per key) while
+    `ReshardPlan(donor=0).split()` refines the map 2 -> 4 live,
+    re-homing class 2 onto the donor's promoted follower. Hard gates:
+
+    - ZERO acked writes lost across the cutover (final read-back per
+      key >= the last acked value) and nothing dropped in the move
+      (every moved-key write in the donor WAL is in the recipient's);
+    - ZERO duplicated applies (each moved key's recipient-WAL value
+      sequence is strictly increasing — single writer, monotone);
+    - the moved keys' measured unavailability (worst per-key ack gap
+      ridden out by `call_with_retry`) stays under
+      `--reshard-unavail-max`: the window is the FENCE, never
+      state-sized;
+    - the quiesced `merge()` folds class 2 back with the same final
+      values at the survivor.
+    """
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    from node_replication_tpu.harness.mkbench import (
+        append_sharded_csv,
+        reshard_rows,
+    )
+    from node_replication_tpu.serve import RetryPolicy, call_with_retry
+    from node_replication_tpu.shard.reshard import ReshardPlan
+
+    t_start = time.monotonic()
+    base = args.reshard_dir or tempfile.mkdtemp(prefix="nr-reshard-")
+    failures: list[str] = []
+    keys = args.txn_keys
+    g = _txn_group(base, keys, with_followers=True)
+    merged_ok = False
+    try:
+        retry = RetryPolicy(max_attempts=512, base_backoff_s=0.001,
+                            max_backoff_s=0.05)
+        stop = threading.Event()
+        n_writers = max(4, args.reshard_clients)
+        # background state OUTSIDE the writer key range, so the WAL
+        # sequence scans below see writer values only
+        for k in range(n_writers, n_writers + 16):
+            g.router.call((HM_PUT, k, 10_000 + k))
+        acked = [0] * n_writers       # last acked value, key = index
+        acks_t = [[] for _ in range(n_writers)]
+        errs: list = []
+
+        def writer(k: int) -> None:
+            v = 0
+            while not stop.is_set():
+                v += 1
+                try:
+                    call_with_retry(g.router, (HM_PUT, k, v),
+                                    policy=retry, deadline_s=30.0)
+                except Exception as e:
+                    errs.append((k, v, e))
+                    return
+                acked[k] = v
+                acks_t[k].append(time.monotonic())
+                time.sleep(0.001)
+
+        threads = [
+            threading.Thread(target=writer, args=(k,),
+                             name=f"reshard-w{k}")
+            for k in range(n_writers)
+        ]
+        for th in threads:
+            th.start()
+        time.sleep(args.reshard_warmup)
+        plan = ReshardPlan(g, donor=0)
+        t_split = time.monotonic()
+        rep = plan.split(catchup_timeout_s=args.sharded_timeout,
+                         drain_timeout_s=args.sharded_timeout)
+        time.sleep(args.reshard_window)
+        stop.set()
+        for th in threads:
+            th.join(timeout=15)
+        t_end = time.monotonic()
+        if errs:
+            failures.append(f"writer errors across the split: "
+                            f"{errs[:3]}")
+
+        moved = [k for k in range(n_writers) if k % 4 == 2]
+        recipient = plan._recipient
+
+        def _read(k: int) -> int:
+            s = g.map.shard_of(k)
+            if s == rep.moved:
+                return int(recipient.frontend.read((HM_GET, k)))
+            return int(g.primaries[s % 2].live_frontend.read(
+                (HM_GET, k)))
+
+        # zero lost acks: values are monotone per key, so a final
+        # state below the last ack means an acked write vanished
+        lost = 0
+        finals = {}
+        for k in range(n_writers):
+            got = _read(k)
+            finals[k] = got
+            if got < acked[k]:
+                lost += 1
+                failures.append(
+                    f"key {k}: last acked value {acked[k]} but "
+                    f"read-back {got} after the split"
+                )
+
+        # the move dropped nothing and applied nothing twice: every
+        # moved-key write the donor WAL holds is in the recipient's,
+        # and each moved key's recipient sequence strictly increases
+        def _wal_seq(wal, want_moved: bool):
+            seqs: dict[int, list[int]] = {}
+            for rec in wal.records(wal.base):
+                for op in rec.ops():
+                    k = int(op[1])
+                    if int(op[0]) != HM_PUT or k >= n_writers:
+                        continue
+                    if (k % 4 == 2) == want_moved:
+                        seqs.setdefault(k, []).append(int(op[2]))
+            return seqs
+
+        donor_seq = _wal_seq(g.primaries[0].wal, True)
+        recip_seq = _wal_seq(recipient.nr.wal, True)
+        dup = 0
+        for k in moved:
+            rs = recip_seq.get(k, [])
+            for a, b in zip(rs, rs[1:]):
+                if b <= a:
+                    dup += 1
+                    failures.append(
+                        f"moved key {k}: recipient applied value {b} "
+                        f"after {a} (duplicate/reorder)"
+                    )
+            missing = set(donor_seq.get(k, [])) - set(rs)
+            if missing:
+                lost += len(missing)
+                failures.append(
+                    f"moved key {k}: donor-WAL writes {sorted(missing)[:4]} "
+                    f"never reached the recipient"
+                )
+
+        # bounded per-moved-key unavailability: the worst ack gap a
+        # moved key saw, anchored at its last pre-fence ack — a key
+        # that NEVER recovered scores the whole remaining run
+        unavail = 0.0
+        for k in moved:
+            prev = [t for t in acks_t[k] if t <= t_split]
+            post = [t for t in acks_t[k] if t > t_split]
+            anchor = prev[-1] if prev else t_split
+            if post:
+                gaps = [post[0] - anchor]
+                gaps += [b - a for a, b in zip(post, post[1:])]
+                unavail = max(unavail, max(gaps))
+            else:
+                unavail = max(unavail, t_end - anchor)
+        if unavail > args.reshard_unavail_max:
+            failures.append(
+                f"moved-key unavailability {unavail:.3f}s exceeds "
+                f"--reshard-unavail-max {args.reshard_unavail_max}s"
+            )
+        moved_writes = sum(len(v) for v in recip_seq.values())
+
+        # quiesced merge folds the class back bit-for-bit
+        rep2 = plan.merge(apply_timeout_s=args.sharded_timeout)
+        for k in range(n_writers):
+            s = g.map.shard_of(k)
+            got = int(g.primaries[s].live_frontend.read((HM_GET, k)))
+            if got != finals[k]:
+                failures.append(
+                    f"merge moved key {k} from {finals[k]} to {got}"
+                )
+                break
+        else:
+            merged_ok = True
+    finally:
+        g.close()
+
+    acked_count = sum(len(t) for t in acks_t)
+    run = {
+        "n_shards": 2,
+        "clients": n_writers,
+        "duration": time.monotonic() - t_start,
+        "acked": acked_count,
+        "lost": lost,
+        "duplicated": dup,
+        "moved_keys": len(moved),
+        "reshard_lost": lost,
+        "reshard_dup": dup,
+        "fence_s": rep.fence_s,
+        "moved_unavail_s": unavail,
+    }
+    append_sharded_csv(args.serve_out, reshard_rows("bench", run))
+    print(json.dumps({
+        "metric": "reshard_unavail_s",
+        "value": round(unavail, 4),
+        "unit": "s",
+        "fence_s": round(rep.fence_s, 4),
+        "catchup_s": round(rep.catchup_s, 4),
+        "drained_records": rep.drained_records,
+        "moved_keys": len(moved),
+        "moved_writes": moved_writes,
+        "acked": acked_count,
+        "lost": lost,
+        "duplicated": dup,
+        "map_versions": [rep.old_version, rep.new_version,
+                         rep2.new_version],
+        "merge_replayed": rep2.drained_records,
+        "merged_ok": merged_ok,
+    }))
+    if not args.reshard_dir:
+        shutil.rmtree(base, ignore_errors=True)
+    if failures:
+        for f in failures:
+            print(f"# FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# reshard OK: live 2->4 split re-homed class 2 "
+        f"({moved_writes} writes) under {acked_count} concurrent "
+        f"acks; lost 0, duplicated 0, fence {rep.fence_s:.3f}s, "
+        f"worst moved-key gap {unavail:.3f}s (gate "
+        f"{args.reshard_unavail_max}s); merge folded "
+        f"{rep2.drained_records} records back exactly",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def tree_follower_main(args) -> int:
     """`--tree-follower` (internal): one LEAF follower process of the
     `--tree` harness. Connects to its assigned relay over TCP, catches
@@ -3949,15 +4513,67 @@ def main():
                          help=argparse.SUPPRESS)  # internal
     sharded.add_argument("--shard-port-file", default=None,
                          help=argparse.SUPPRESS)  # internal
+
+    txn = p.add_argument_group(
+        "txn", "cross-shard transaction + online-resharding gates "
+        "(--txn / --reshard): a SIGKILL matrix over the 2PC crash "
+        "windows with zero-half-committed read-back gates, and a "
+        "live 2->4 keyspace split under closed-loop writers with "
+        "zero-lost/zero-dup + bounded-unavailability gates")
+    txn.add_argument("--txn", action="store_true",
+                     help="run the 2PC crash-matrix gate")
+    txn.add_argument("--txn-rounds", type=int, default=3,
+                     help="SIGKILL rounds (cycling the prepare / "
+                     "commit / decide crash windows; default 3)")
+    txn.add_argument("--txn-count", type=int, default=24,
+                     help="transactions each kill-round child "
+                     "drives (the kill lands mid-stream)")
+    txn.add_argument("--txn-keys", type=int, default=4096,
+                     help="hashmap keyspace for the txn/reshard "
+                     "fleets")
+    txn.add_argument("--txn-parity-seconds", type=float, default=1.5,
+                     help="total wall time of the non-txn "
+                     "throughput-parity leg (alternating slices)")
+    txn.add_argument("--txn-parity-min", type=float, default=0.9,
+                     help="gate: with_txn fleet must serve non-txn "
+                     "single-shard writes at >= this fraction of a "
+                     "txn-free build (default 0.9)")
+    txn.add_argument("--txn-timeout", type=float, default=60.0,
+                     help="per-child watchdog for the kill rounds")
+    txn.add_argument("--txn-dir", default=None,
+                     help="working dir for --txn (kept; default: "
+                     "fresh temp dir, removed)")
+    txn.add_argument("--reshard", action="store_true",
+                     help="run the live-split + merge gate")
+    txn.add_argument("--reshard-clients", type=int, default=8,
+                     help="closed-loop writer threads (one key "
+                     "each, covering all mod-4 classes)")
+    txn.add_argument("--reshard-warmup", type=float, default=0.5,
+                     help="seconds of traffic before the split")
+    txn.add_argument("--reshard-window", type=float, default=1.5,
+                     help="seconds of traffic after the split")
+    txn.add_argument("--reshard-unavail-max", type=float, default=5.0,
+                     help="gate: worst per-moved-key ack gap across "
+                     "the cutover (seconds)")
+    txn.add_argument("--reshard-dir", default=None,
+                     help="working dir for --reshard (kept; "
+                     "default: fresh temp dir, removed)")
+    txn.add_argument("--txn-child", action="store_true",
+                     help=argparse.SUPPRESS)  # internal: kill victim
+    txn.add_argument("--txn-kill-site", default="none",
+                     help=argparse.SUPPRESS)  # internal
+    txn.add_argument("--txn-kill-after", type=int, default=0,
+                     help=argparse.SUPPRESS)  # internal
     args = p.parse_args()
     if args.max_attempts < 1:
         p.error("--max-attempts must be >= 1")
     if sum(map(bool, (args.chaos, args.serve, args.crash,
                       args.follower, args.tree, args.overload,
-                      args.mesh, args.kernel, args.sharded))) > 1:
+                      args.mesh, args.kernel, args.sharded,
+                      args.txn, args.reshard))) > 1:
         p.error("--chaos, --serve, --crash, --follower, --tree, "
-                "--overload, --mesh, --kernel and --sharded are "
-                "mutually exclusive")
+                "--overload, --mesh, --kernel, --sharded, --txn "
+                "and --reshard are mutually exclusive")
     if args.sharded and args.sharded_shards < 2:
         p.error("--sharded needs --sharded-shards >= 2 (the kill leg "
                 "promotes one shard while the others hold)")
@@ -3982,10 +4598,18 @@ def main():
             p.error("--tree-follower requires --crash-dir, "
                     "--tree-connect and --tree-result-file")
         sys.exit(tree_follower_main(args))
+    if args.txn_child:
+        if not args.txn_dir:
+            p.error("--txn-child requires --txn-dir")
+        sys.exit(txn_child_main(args))
     if args.follower:
         sys.exit(follower_main(args))
     if args.sharded:
         sys.exit(sharded_main(args))
+    if args.txn:
+        sys.exit(txn_main(args))
+    if args.reshard:
+        sys.exit(reshard_main(args))
     if args.tree:
         sys.exit(tree_main(args))
     if args.crash:

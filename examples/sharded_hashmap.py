@@ -8,8 +8,11 @@ the keyspace into congruence classes (`key % n_shards`), a
 feed, and follower), a `ShardRouter` fanning a mixed batch out and
 reassembling responses in submission order, the typed `WrongShard`
 fence a mis-routed or version-stale submit hits BEFORE any log
-effect, the explicit cross-shard non-atomicity contract, and finally
-one shard's death — its follower promotes, the bumped map
+effect, an ATOMIC cross-shard transfer through the 2PC layer — one
+that an injected coordinator crash mid-prepare provably cannot
+half-apply (presumed abort cleans up, balances untouched) — the
+per-op-outcome contract of plain (non-txn) cross-shard batches, and
+finally one shard's death — its follower promotes, the bumped map
 re-publishes, and `call_with_retry` rides the outage without the
 caller ever seeing it.
 
@@ -27,6 +30,11 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")  # example-scale: skip the TPU tunnel
 
+from node_replication_tpu.fault.inject import (
+    FaultError,
+    FaultPlan,
+    FaultSpec,
+)
 from node_replication_tpu.models import HM_GET, HM_PUT, make_hashmap
 from node_replication_tpu.serve import (
     RetryPolicy,
@@ -67,6 +75,45 @@ def main():
         print(f"mis-routed key {e.key} refused: belongs to shard "
               f"{e.expected_shard}, and shard 0's log never moved")
 
+    # --- cross-shard transfer: atomic, and crash-proof -----------------
+    # keys 2 (shard 2) and 4 (shard 1) hold balances; a transfer must
+    # debit one and credit the other on DIFFERENT primaries with no
+    # half-applied state, ever — the 2PC layer's contract
+    def balance(k):
+        fe = g.primaries[g.map.shard_of(k)].live_frontend
+        return int(fe.read((HM_GET, k, 0), rid=0))
+
+    coord = g.coordinator()
+    a, b = balance(2), balance(4)
+    coord.execute_txn([(HM_PUT, 2, a - 30), (HM_PUT, 4, b + 30)])
+    assert balance(2) == a - 30 and balance(4) == b + 30
+    print(f"cross-shard transfer committed atomically: "
+          f"k2 {a}->{a - 30} (shard 2), k4 {b}->{b + 30} (shard 1); "
+          f"the commit decision was durable before the ack")
+
+    # now the coordinator "dies" mid-prepare: shard 2's yes-vote is
+    # journaled and its key locked, but no decision was ever
+    # published. Recovery bumps the coordinator epoch and every
+    # participant PRESUMED-ABORTS the orphaned intent — the transfer
+    # either happened everywhere or nowhere, even across the crash
+    a, b = balance(2), balance(4)
+    crash = FaultPlan([FaultSpec(site="txn-prepare", action="raise",
+                                 rid=-1, after=1)])
+    with crash.armed():
+        try:
+            coord.execute_txn([(HM_PUT, 2, a - 30), (HM_PUT, 4, b + 30)])
+            raise AssertionError("injected crash must surface")
+        except FaultError:
+            pass
+    g.coordinator(name="recovery")       # durable epoch bump
+    outcomes = g.resolve_in_doubt()
+    assert balance(2) == a and balance(4) == b  # NOT half-applied
+    assert int(r.call((HM_PUT, 2, a))) >= 0     # locks released
+    print(f"coordinator killed mid-prepare: in-doubt intent resolved "
+          f"{dict((s, o) for s, o in outcomes.items() if o)} by "
+          f"presumed abort — balances untouched, locks released, "
+          f"zero half-applied state")
+
     # --- one slice dies: unavailability is typed AND contained ---------
     g.kill_primary(0)
     try:
@@ -78,14 +125,16 @@ def main():
     print("shard 0 dead: its slice is typed-unavailable "
           "(maybe_executed=False), the other slices serve on")
 
-    # cross-shard batches are explicitly NOT atomic: per-op outcomes
+    # plain (non-txn) cross-shard batches keep per-op outcomes: ops
+    # on live slices commit even when another slice is down — use
+    # `coord.execute_txn` when all-or-nothing is the requirement
     out = r.execute_batch([(HM_PUT, 0, 7), (HM_PUT, 2, 8)],
                           return_exceptions=True)
     assert isinstance(out[0], ShardUnavailable)
     assert int(out[1]) >= 0  # shard 2 committed independently
-    print("cross-shard batch under the outage: op on the dead slice "
-          "rejected, op on a live slice committed (no atomicity, "
-          "by contract)")
+    print("non-txn batch under the outage: op on the dead slice "
+          "rejected, op on a live slice committed (per-op outcomes, "
+          "by contract; execute_txn is the atomic surface)")
 
     # --- promote + re-home: bumped map, fenced zombie, acks survive ----
     report = g.promote(0)

@@ -2088,3 +2088,91 @@ def unrouted_key_in_shard_path(mod: ModuleInfo,
                 f"keyspace slice; route (or re-verify) through the "
                 f"map before submitting",
             )
+
+
+# --------------------------------------------------------------------------
+# txn-ack-before-decision
+# --------------------------------------------------------------------------
+
+#: the prepare step of the 2PC protocol (`shard/txn.py`): an attribute
+#: call `.prepare(...)` or a verb string handed to a dispatch helper
+#: (`_verb_rehomed(s, "prepare", ...)`, `txn_verb("prepare", ...)`)
+_TXN_PREPARE_ATTRS = frozenset({"prepare"})
+
+#: sites that resolve the CALLER's view of the transaction — a future
+#: resolution or an ok-frame reply. `set_exception` is exempt: failing
+#: the caller never claims the transaction decided.
+_TXN_ACK_ATTRS = frozenset({"set_result", "send_ok", "reply_ok"})
+
+#: the durable decision point (`durable/txnlog.py DecisionLog.publish`
+#: via `durable_publish`): the only thing allowed to dominate an ack
+_TXN_DECISION_NAMES = frozenset(
+    {"publish", "publish_decision", "durable_publish", "decide"}
+)
+
+
+@rule(
+    "txn-ack-before-decision", ERROR,
+    "txn path acks the caller with no durable decision publish "
+    "dominating it in the same function",
+)
+def txn_ack_before_decision(mod: ModuleInfo,
+                            project: Project) -> Iterator[Diagnostic]:
+    """The 2PC commit point is the DURABLE DECISION RECORD, nothing
+    else (`shard/txn.py`): once a coordinator tells its caller the
+    transaction committed, a crash one instruction later must leave
+    behind a decision document that recovery can re-drive — otherwise
+    the prepared participants presumed-abort a transaction the caller
+    was told succeeded, which is precisely the half-committed state
+    the whole layer exists to rule out. Machine-checked shape: a
+    shard/ function that drives a prepare verb AND resolves the
+    caller's future (`.set_result`) or sends an ok frame must have a
+    decision publish (`DecisionLog.publish` / `durable_publish`) at an
+    earlier line of the same function. `set_exception` is exempt —
+    reporting failure never claims a decision. Scoped per function
+    for the same reason as `unrouted-key-in-shard-path`: the decision
+    and the ack belong in the same protocol step, not "somewhere in
+    the module"."""
+    parts = re.split(r"[\\/]+", mod.path)
+    if "shard" not in parts[:-1]:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        prepare_line = None
+        decision_lines = []
+        acks = []
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if attr is None:
+                continue
+            if attr in _TXN_PREPARE_ATTRS or any(
+                isinstance(a, ast.Constant) and a.value == "prepare"
+                for a in sub.args
+            ):
+                if prepare_line is None or sub.lineno < prepare_line:
+                    prepare_line = sub.lineno
+            elif attr in _TXN_ACK_ATTRS:
+                acks.append(sub)
+            elif attr in _TXN_DECISION_NAMES:
+                decision_lines.append(sub.lineno)
+        if prepare_line is None:
+            continue
+        for ack in acks:
+            if any(dl < ack.lineno for dl in decision_lines):
+                continue
+            yield _diag(
+                mod, ack, "txn-ack-before-decision",
+                f"{node.name}: .{ack.func.attr if isinstance(ack.func, ast.Attribute) else ack.func.id}"
+                f"() acks the transaction to the caller with no "
+                f"durable decision publish (DecisionLog.publish / "
+                f"durable_publish) at an earlier line of the same "
+                f"function — a crash after this ack presumed-aborts "
+                f"a transaction the caller was told committed",
+            )

@@ -31,6 +31,11 @@ from node_replication_tpu.durable.recovery import (
     save_durable_snapshot,
     snapshot_path,
 )
+from node_replication_tpu.durable.txnlog import (
+    DecisionLog,
+    TxnIntentLog,
+    TxnLogCorruptError,
+)
 from node_replication_tpu.durable.wal import (
     FSYNC_POLICIES,
     WalCorruptError,
@@ -40,8 +45,11 @@ from node_replication_tpu.durable.wal import (
 )
 
 __all__ = [
+    "DecisionLog",
     "FSYNC_POLICIES",
     "RecoveryReport",
+    "TxnIntentLog",
+    "TxnLogCorruptError",
     "WAL_SUBDIR",
     "WalCorruptError",
     "WalError",

@@ -48,7 +48,9 @@ Actions:
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
+import signal
 import threading
 
 from node_replication_tpu.analysis.locks import make_lock
@@ -66,8 +68,21 @@ from node_replication_tpu.utils.trace import get_tracer
 SITES = ("replay", "append", "read-sync", "serve-batch",
          "serve-complete",
          "wal-append", "wal-fsync", "wal-open",
-         "ship", "repl-apply")
-ACTIONS = ("raise", "stall", "corrupt", "corrupt-bytes")
+         "ship", "repl-apply",
+         # the 2PC plane (`shard/txn.py`): after a participant's
+         # durable yes-vote / after the coordinator's durable decision
+         # publish / between a participant's apply and its resolved
+         # record — the three windows the txn recovery story must
+         # survive (bench.py --txn kills processes at exactly these)
+         "txn-prepare", "txn-decide", "txn-commit")
+ACTIONS = ("raise", "stall", "corrupt", "corrupt-bytes", "kill")
+
+#: what `FaultPlan.chaos` samples from — the ORIGINAL in-process-safe
+#: subsets, pinned: existing seeds keep their schedules, and a random
+#: schedule can never draw `kill` (which would SIGKILL the host
+#: process) or a txn site the armed workload does not exercise.
+CHAOS_SITES = SITES[:10]
+CHAOS_ACTIONS = ACTIONS[:4]
 
 # Upper bound on an injected stall: stalls must stay bounded so a
 # chaos run can never wedge — long enough for the watchdog/health
@@ -175,7 +190,7 @@ class FaultPlan:
 
     @classmethod
     def chaos(cls, seed: int, n_faults: int = 3, n_replicas: int = 2,
-              sites=SITES, actions=ACTIONS,
+              sites=CHAOS_SITES, actions=CHAOS_ACTIONS,
               max_after: int = 64) -> "FaultPlan":
         """Sample a reproducible random schedule: `n_faults` specs drawn
         from `sites` x `actions` x `[0, n_replicas)` x `[0, max_after]`
@@ -251,6 +266,14 @@ class FaultPlan:
         target = spec.rid if spec.rid != -1 else (rid if rid != -1 else 0)
         if spec.action == "raise":
             raise FaultError(site, target)
+        if spec.action == "kill":
+            # a REAL SIGKILL of this process — no atexit, no flushes,
+            # no unwinding: the crash the durability planes' fsync-
+            # before-ack contracts are written against. Only the txn
+            # bench's child processes arm this (`bench.py --txn`);
+            # never sample it into an in-process chaos schedule.
+            os.kill(os.getpid(), signal.SIGKILL)
+            return  # pragma: no cover — unreachable after SIGKILL
         if spec.action == "stall":
             # injected clock: under `SimClock` a stall is a virtual-
             # time event (instant in wall time, visible in timelines)

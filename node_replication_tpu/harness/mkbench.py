@@ -81,6 +81,20 @@ _SHARDED_FIELDS = [
     "acked", "victim_shard", "victim_acked",
     "detect_s", "promote_s", "rto_s", "survivor_hold",
     "lost", "duplicated", "post_promote_ops",
+    # --txn 2PC crash-matrix columns (blank on plain --sharded rows;
+    # `_append_csv`'s header-upgrade rewrite keeps pre-txn CSVs
+    # aligned): kill rounds run / acked txns verified by per-key
+    # read-back / in-doubt intents found and resolved after restart /
+    # half-committed txns observed (gated to 0) / non-txn throughput
+    # parity vs a with_txn=False fleet (gated >= 0.9)
+    "txn_rounds", "txn_acked", "txn_in_doubt", "txn_resolved",
+    "txn_half_committed", "txn_parity",
+    # --reshard live-split columns: keys re-homed by the N->2N split /
+    # acked writes lost or duplicated across the cutover (gated to 0)
+    # / the split's fence window / the worst measured per-moved-key
+    # ack gap (the ONLINE claim, gated)
+    "moved_keys", "reshard_lost", "reshard_dup",
+    "fence_s", "moved_unavail_s",
 ]
 # One row per (device count) point of a mesh scaling curve
 # (`bench.py --mesh`): replayed-dispatch throughput at that width,
@@ -1753,6 +1767,46 @@ def sharded_rows(name: str, run: dict) -> list[dict]:
 def append_sharded_csv(out_dir: str, rows: list[dict]) -> None:
     _append_csv(os.path.join(out_dir, SHARDED_CSV),
                 _SHARDED_FIELDS, rows)
+
+
+def txn_rows(name: str, run: dict) -> list[dict]:
+    """The SHARDED_CSV row for one `bench.py --txn` run dict: the
+    SIGKILL-matrix atomicity gate plus the non-txn throughput-parity
+    leg (columns the plain --sharded rows leave blank)."""
+    return [{
+        "name": f"{name}/sharded-txn",
+        "n_shards": run["n_shards"],
+        "clients": run["clients"],
+        "duration": round(run["duration"], 3),
+        "acked": run["acked"],
+        "lost": run["lost"],
+        "duplicated": run["duplicated"],
+        "txn_rounds": run["txn_rounds"],
+        "txn_acked": run["txn_acked"],
+        "txn_in_doubt": run["txn_in_doubt"],
+        "txn_resolved": run["txn_resolved"],
+        "txn_half_committed": run["txn_half_committed"],
+        "txn_parity": round(run["txn_parity"], 3),
+    }]
+
+
+def reshard_rows(name: str, run: dict) -> list[dict]:
+    """The SHARDED_CSV row for one `bench.py --reshard` run dict: the
+    live N->2N split's exactness + bounded-unavailability gates."""
+    return [{
+        "name": f"{name}/sharded-reshard",
+        "n_shards": run["n_shards"],
+        "clients": run["clients"],
+        "duration": round(run["duration"], 3),
+        "acked": run["acked"],
+        "lost": run["lost"],
+        "duplicated": run["duplicated"],
+        "moved_keys": run["moved_keys"],
+        "reshard_lost": run["reshard_lost"],
+        "reshard_dup": run["reshard_dup"],
+        "fence_s": round(run["fence_s"], 4),
+        "moved_unavail_s": round(run["moved_unavail_s"], 4),
+    }]
 
 
 def measure_native(

@@ -32,6 +32,9 @@ from node_replication_tpu.serve.errors import (
     ServeError,
     ShardUnavailable,
     StaleRead,
+    TxnAborted,
+    TxnConflict,
+    TxnInDoubt,
     WrongShard,
 )
 from node_replication_tpu.serve.frontend import (
@@ -69,6 +72,9 @@ __all__ = [
     "ServeFuture",
     "ShardUnavailable",
     "StaleRead",
+    "TxnAborted",
+    "TxnConflict",
+    "TxnInDoubt",
     "WrongShard",
     "call_with_retry",
 ]

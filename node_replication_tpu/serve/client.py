@@ -76,6 +76,7 @@ from node_replication_tpu.serve.errors import (
     Overloaded,
     ReplicaFailed,
     ShardUnavailable,
+    TxnConflict,
     WrongShard,
 )
 from node_replication_tpu.utils.clock import get_clock
@@ -251,6 +252,13 @@ _RETRY_CAUSES = {
     # when maybe_executed=False), so the retry is exactly-once safe
     ShardUnavailable: "shard_unavailable",
     WrongShard: "wrong_shard",
+    # the txn plane (`shard/txn.py`): a key locked by a prepared-but-
+    # undecided transaction; zero log effect, and the lock clears the
+    # moment the decision arrives — Overloaded-shaped backoff applies.
+    # TxnAborted/TxnInDoubt are deliberately ABSENT: they are whole-
+    # transaction outcomes the coordinator's caller routes on, never
+    # per-op transients.
+    TxnConflict: "txn_conflict",
 }
 
 
@@ -330,7 +338,7 @@ def call_with_retry(
                 breaker.record_success()
             return resp
         except (Overloaded, ReplicaFailed, CircuitOpen,
-                ShardUnavailable, WrongShard) as e:
+                ShardUnavailable, WrongShard, TxnConflict) as e:
             if isinstance(e, (ReplicaFailed, ShardUnavailable)) \
                     and e.maybe_executed:
                 # the op may already be in the log (it WILL replay;

@@ -47,6 +47,22 @@ flat under `ServeError`:
   in flight). Transient by design when `maybe_executed=False`;
   `call_with_retry` backs off and retries, and the router re-routes
   once the shard's `PromotionManager` re-homes it.
+- `TxnConflict` — an op touched a key locked by a prepared-but-
+  undecided cross-shard transaction (`shard/txn.py`). Zero log
+  effect; retryable by design (`call_with_retry` backs off — the
+  lock clears as soon as the transaction resolves).
+- `TxnAborted` — a cross-shard transaction aborted during prepare.
+  Presumed-abort 2PC guarantees ZERO log effect on every
+  participant, so retrying the WHOLE transaction is exactly-once
+  safe (the coordinator's caller decides; per-op retry machinery
+  never sees this).
+- `TxnInDoubt` — the coordinator lost a participant AFTER the
+  durable decision was published (or could not finish phase 2). The
+  transaction's outcome is decided and will be enforced by
+  recovery — but this caller cannot prove it applied yet. Never
+  auto-retried; resolve by decision lookup
+  (`TxnCoordinator.recover` / participant `resolve_in_doubt`) or a
+  read.
 """
 
 from __future__ import annotations
@@ -270,3 +286,79 @@ class ShardUnavailable(ServeError):
     @property
     def retryable(self) -> bool:
         return not self.maybe_executed
+
+
+class TxnConflict(ServeError):
+    """The op's key is locked by a prepared-but-undecided cross-shard
+    transaction (`shard/txn.py:TxnParticipant`).
+
+    A prepared intent blocks CONFLICTING KEYS, not the shard: every
+    other key serves normally, and this op was rejected before any
+    log effect. Retrying with backoff is always safe — the lock
+    clears the moment the transaction's decision arrives (or, for a
+    dead coordinator generation, when presumed abort releases it);
+    `call_with_retry` classifies this exactly like `Overloaded`.
+    """
+
+    def __init__(self, key: int, txn: str):
+        super().__init__(
+            f"key {key} is locked by prepared transaction {txn}; "
+            f"op rejected before any log effect"
+        )
+        self.key = key
+        self.txn = txn
+        self.maybe_executed = False  # rejected at the door, always
+
+    @property
+    def retryable(self) -> bool:
+        return True
+
+
+class TxnAborted(ServeError):
+    """The cross-shard transaction aborted during prepare
+    (`shard/txn.py:TxnCoordinator`).
+
+    Presumed-abort 2PC's clean failure: some participant voted no
+    (conflict, wrong shard, unavailable) before any decision was
+    published, every prepared intent was (or will be, by presumed
+    abort) dropped, and NO participant applied anything — the whole
+    transaction had zero log effect, so resubmitting the whole
+    transaction is exactly-once safe. The caller retries; the per-op
+    retry machinery never sees this error.
+    """
+
+    def __init__(self, txn: str, cause: BaseException | None = None):
+        detail = f" ({type(cause).__name__}: {cause})" if cause else ""
+        super().__init__(
+            f"transaction {txn} aborted during prepare{detail}; "
+            f"zero log effect on every participant"
+        )
+        self.txn = txn
+        self.cause = cause
+
+
+class TxnInDoubt(ServeError):
+    """The transaction's durable decision exists but this caller
+    could not confirm phase 2 completed on every participant.
+
+    The `maybe_executed=True` of the transaction layer: the decision
+    record (`durable/txnlog.py:DecisionLog`) is the truth and
+    recovery WILL enforce it — participants re-resolve by decision
+    lookup, the restarted coordinator re-drives commits — but right
+    now some sub-batch may or may not have applied. Never
+    auto-retried (a blind resubmit could double-apply); the caller
+    resolves via `TxnCoordinator.recover()`, participant
+    `resolve_in_doubt()`, or a read of the affected keys.
+    """
+
+    def __init__(self, txn: str, decision: str | None = None,
+                 cause: BaseException | None = None):
+        detail = f" ({type(cause).__name__}: {cause})" if cause else ""
+        dec = f"decision={decision!r}" if decision else "undecided"
+        super().__init__(
+            f"transaction {txn} in doubt ({dec}){detail}; recovery "
+            f"will enforce the durable decision — do not blindly retry"
+        )
+        self.txn = txn
+        self.decision = decision
+        self.cause = cause

@@ -57,7 +57,10 @@ class ShardPrimary:
                  with_follower: bool = True,
                  heartbeat_timeout_s: float = 0.5,
                  poll_s: float = 0.002,
-                 auto_start_watch: bool = False):
+                 auto_start_watch: bool = False,
+                 recover: bool = False,
+                 with_txn: bool = True,
+                 decisions=None):
         from node_replication_tpu import NodeReplicated
         from node_replication_tpu.durable import WriteAheadLog
         from node_replication_tpu.repl import (
@@ -83,13 +86,27 @@ class ShardPrimary:
                 "(ServeConfig(durability='batch'))"
             )
         self.dispatch = dispatch
-        self.nr = NodeReplicated(
-            dispatch, **(nr_kwargs or _default_nr_kwargs())
-        )
-        self.wal = WriteAheadLog(
-            os.path.join(self.primary_dir, "wal"), policy="batch"
-        )
-        self.nr.attach_wal(self.wal)
+        self.recovery = None
+        if recover:
+            # restart-in-place: rebuild this slice from its own
+            # snapshots + WAL; the shipper then resumes at the feed's
+            # persisted tail (ship-before-ack means nothing acked is
+            # missing from either artifact)
+            from node_replication_tpu.durable.recovery import \
+                recover_fleet
+            self.nr, self.recovery = recover_fleet(
+                self.primary_dir, dispatch,
+                nr_kwargs=nr_kwargs or _default_nr_kwargs(),
+            )
+            self.wal = self.nr.wal
+        else:
+            self.nr = NodeReplicated(
+                dispatch, **(nr_kwargs or _default_nr_kwargs())
+            )
+            self.wal = WriteAheadLog(
+                os.path.join(self.primary_dir, "wal"), policy="batch"
+            )
+            self.nr.attach_wal(self.wal)
         self.feed = DirectoryFeed(
             self.feed_dir, arg_width=self.nr.spec.arg_width
         )
@@ -114,6 +131,18 @@ class ShardPrimary:
             )
             if auto_start_watch:
                 self.manager.start()
+        self.txn = None
+        if with_txn:
+            # 2PC participant over THIS shard's frontend + WAL. Costs
+            # the non-txn path nothing: `submit_batch` consults it
+            # through one `has_locks()` flag read and the intent log
+            # is an empty fsynced file until the first prepare.
+            from node_replication_tpu.shard.txn import TxnParticipant
+            self.txn = TxnParticipant(
+                self.shard, self.frontend, shard_map,
+                os.path.join(base_dir, "txn"),
+                decisions=decisions, wal=self.wal,
+            )
         self._primary_dead = False
 
     @property
@@ -144,6 +173,8 @@ class ShardPrimary:
         return self.manager.promote_now(detect_s=detect_s)
 
     def close(self) -> None:
+        if self.txn is not None:
+            self.txn.close()
         if not self._primary_dead:
             self.shipper.stop()
             self.frontend.close()
@@ -176,11 +207,31 @@ class ShardGroup:
                  config=None, nr_kwargs: dict | None = None,
                  with_followers: bool = True,
                  heartbeat_timeout_s: float = 0.5,
-                 concurrent_router: bool = True):
+                 concurrent_router: bool = True,
+                 with_txn: bool = True,
+                 recover: bool = False):
+        from node_replication_tpu.durable import DecisionLog
+
         self.base_dir = base_dir
         os.makedirs(base_dir, exist_ok=True)
-        self.map = ShardMap(n_shards)
-        self.map.publish(base_dir)
+        self.decisions_dir = os.path.join(base_dir, "decisions")
+        self.decisions = DecisionLog(self.decisions_dir) \
+            if with_txn else None
+        #: participants created by a reshard (`shard/reshard.py`) for
+        #: the refined classes — owned here so `close()` reaps them
+        self.extra_participants: list = []
+        if recover:
+            # restart-in-place: adopt the published map (version and
+            # all) instead of stamping a fresh version-1 map over it
+            self.map = ShardMap.load(base_dir)
+            if self.map.n_shards != n_shards:
+                raise ValueError(
+                    f"published map has {self.map.n_shards} shards, "
+                    f"caller expected {n_shards}"
+                )
+        else:
+            self.map = ShardMap(n_shards)
+            self.map.publish(base_dir)
         self.primaries = [
             ShardPrimary(
                 s, dispatch,
@@ -188,18 +239,50 @@ class ShardGroup:
                 self.map, config=config, nr_kwargs=nr_kwargs,
                 with_follower=with_followers,
                 heartbeat_timeout_s=heartbeat_timeout_s,
+                recover=recover, with_txn=with_txn,
+                decisions=self.decisions,
             )
             for s in range(n_shards)
         ]
         self.router = ShardRouter(
             self.map,
             {
-                s: LocalBackend(s, self.primaries[s].frontend, self.map)
+                s: LocalBackend(
+                    s, self.primaries[s].frontend, self.map,
+                    participant=self.primaries[s].txn,
+                )
                 for s in range(n_shards)
             },
             map_path=base_dir,
             concurrent=concurrent_router,
         )
+
+    def coordinator(self, name: str = "coord"):
+        """A `TxnCoordinator` over this group's router, sharing the
+        fleet's decision directory — the one participants consult in
+        `resolve_in_doubt`. Each construction durably bumps the
+        coordinator epoch (older generations' undecided intents
+        become presumed-abortable)."""
+        if self.decisions is None:
+            raise RuntimeError("group built with with_txn=False")
+        from node_replication_tpu.shard.txn import TxnCoordinator
+        return TxnCoordinator(self.router, self.decisions_dir,
+                              name=name)
+
+    def resolve_in_doubt(self) -> dict:
+        """Run every participant's in-doubt resolution against the
+        shared decision log (the restart path after a coordinator or
+        participant crash). Returns `{shard: {txn: outcome}}`."""
+        epoch = self.decisions.epoch() if self.decisions else 0
+        out = {}
+        parts = [p.txn for p in self.primaries] + \
+            list(self.extra_participants)
+        for t in parts:
+            if t is not None:
+                out[t.shard] = t.resolve_in_doubt(
+                    decisions=self.decisions, epoch=epoch
+                )
+        return out
 
     @property
     def n_shards(self) -> int:
@@ -221,13 +304,25 @@ class ShardGroup:
         self.map = new_map
         for q in self.primaries:
             q.map = new_map
+            if q.txn is not None:
+                q.txn.set_map(new_map)
+        if p.txn is not None:
+            # re-home the participant too: prepared intents survive
+            # (the intent log is the shard's, not the primary's) and
+            # future commits apply through the promoted frontend
+            p.txn.set_frontend(p.live_frontend,
+                               wal=p.follower.nr.wal)
         self.router.repoint(
-            s, LocalBackend(s, p.live_frontend, new_map),
+            s, LocalBackend(s, p.live_frontend, new_map,
+                            participant=p.txn),
             new_map=new_map,
         )
         return report
 
     def close(self) -> None:
         self.router.close()
+        for t in self.extra_participants:
+            if t is not None:
+                t.close()
         for p in self.primaries:
             p.close()

@@ -37,6 +37,25 @@ from node_replication_tpu.durable.wal import durable_publish
 MAP_FILENAME = "shard_map.json"
 
 
+class ShardMapCorruptError(RuntimeError):
+    """A published `shard_map.json` failed validation on load.
+
+    The `WalCorruptError`/`SnapshotCorruptError` discipline applied to
+    the routing control file: `durable_publish` guarantees a reader
+    never sees a TORN document, so a file that fails to parse — or
+    parses into an inconsistent map (address count != `n_shards`,
+    non-positive version) — is bit rot or a hand edit, and must be a
+    TYPED refusal the router's `refresh_map()` can survive (keep the
+    old map, count `shard.map_corrupt`) rather than a raw
+    `JSONDecodeError`/`KeyError` escaping into the retry path."""
+
+    def __init__(self, path: str | None, detail: str):
+        where = f" at {path}" if path else ""
+        super().__init__(f"corrupt shard map{where}: {detail}")
+        self.path = path
+        self.detail = detail
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardMap:
     """Immutable, versioned keyspace map.
@@ -106,6 +125,36 @@ class ShardMap:
             else None
         return ShardMap(self.n_shards, self.version + 1, tuple(addrs))
 
+    def refine(self, overrides: dict | None = None) -> "ShardMap":
+        """The reshard doubling (`shard/reshard.py`): every class `s`
+        of `N` refines into `{s, s + N}` under `mod 2N` — a key in
+        class `s (mod N)` is in class `s` or `s + N (mod 2N)`, never
+        anywhere else, so the refinement moves ONLY the keys whose new
+        class is re-addressed. By default class `s + N` keeps class
+        `s`'s address (the same primary serves both halves until a
+        split re-homes one); `overrides` maps new-shard → address for
+        the re-homed slices. Version bumps once."""
+        addrs = list(self.addresses) * 2
+        for s, addr in (overrides or {}).items():
+            if not (0 <= int(s) < 2 * self.n_shards):
+                raise ValueError(f"shard {s} out of range for refine")
+            addrs[int(s)] = tuple(addr) if addr is not None else None
+        return ShardMap(2 * self.n_shards, self.version + 1,
+                        tuple(addrs))
+
+    def coarsen(self) -> "ShardMap":
+        """The merge inverse of `refine`: classes `{s, s + N}` under
+        `mod 2N` collapse back into class `s` under `mod N`, each
+        merged class served at the LOWER half's address. Requires an
+        even shard count (only a refined map coarsens)."""
+        if self.n_shards % 2:
+            raise ValueError(
+                f"cannot coarsen an odd shard count ({self.n_shards})"
+            )
+        half = self.n_shards // 2
+        return ShardMap(half, self.version + 1,
+                        tuple(self.addresses[:half]))
+
     def as_dict(self) -> dict:
         return {
             "n_shards": self.n_shards,
@@ -115,15 +164,33 @@ class ShardMap:
         }
 
     @classmethod
-    def from_dict(cls, d: dict) -> "ShardMap":
-        return cls(
-            n_shards=int(d["n_shards"]),
-            version=int(d["version"]),
-            addresses=tuple(
+    def from_dict(cls, d: dict, path: str | None = None) -> "ShardMap":
+        """Validate + build. EVERY defect in the document — missing
+        keys, non-numeric fields, an address list whose length
+        disagrees with `n_shards` — is a typed `ShardMapCorruptError`
+        so the router's refresh path can keep its old map instead of
+        crashing on a raw `KeyError`."""
+        try:
+            n_shards = int(d["n_shards"])
+            version = int(d["version"])
+            addresses = tuple(
                 tuple(a) if a is not None else None
                 for a in d.get("addresses", [])
-            ),
-        )
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise ShardMapCorruptError(
+                path, f"{type(e).__name__}: {e}"
+            ) from e
+        if addresses and len(addresses) != n_shards:
+            raise ShardMapCorruptError(
+                path,
+                f"{len(addresses)} addresses for {n_shards} shards",
+            )
+        try:
+            return cls(n_shards=n_shards, version=version,
+                       addresses=addresses)
+        except ValueError as e:
+            raise ShardMapCorruptError(path, str(e)) from e
 
     def publish(self, path: str) -> None:
         """Durably publish this map (atomic tmp + fsync + rename via
@@ -140,8 +207,21 @@ class ShardMap:
     @classmethod
     def load(cls, path: str) -> "ShardMap":
         """Load a published map. Always observes a COMPLETE document
-        (the `durable_publish` rename guarantee)."""
+        (the `durable_publish` rename guarantee) — so a document that
+        does not parse/validate is corruption or a hand edit, raised
+        as typed `ShardMapCorruptError` (missing file stays a plain
+        `FileNotFoundError`: absent and corrupt are different
+        failures)."""
         if os.path.isdir(path):
             path = os.path.join(path, MAP_FILENAME)
         with open(path, "rb") as f:
-            return cls.from_dict(json.loads(f.read().decode()))
+            raw = f.read()
+        try:
+            doc = json.loads(raw.decode())
+        except ValueError as e:
+            raise ShardMapCorruptError(path, f"bad JSON: {e}") from e
+        if not isinstance(doc, dict):
+            raise ShardMapCorruptError(
+                path, f"expected an object, got {type(doc).__name__}"
+            )
+        return cls.from_dict(doc, path=path)

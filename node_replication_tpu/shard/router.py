@@ -20,16 +20,19 @@ submission order. Backends come in two shapes behind one protocol
   server checks it on every submit — a fenced zombie shard (stale
   map after a promotion re-published it) can never ack.
 
-**The cross-shard contract is the CNR one — explicitly NOT atomic.**
-Ops on different shards live in disjoint `key % N` congruence classes
-(`shard/ring.py`), so their sub-batches execute concurrently and
-independently: one shard's sub-batch can commit and ack while
-another's fails (`ShardUnavailable`), exactly as CNR's per-log
-batches commit independently (PAPER.md; `models/partitioned.py` pins
-the same semantics in-process). `execute_batch` therefore reports
-per-op outcomes; there is no cross-shard rollback. Callers that need
-multi-shard atomicity need a transaction layer (2PC) on top — see
-README "Keyspace sharding".
+**The cross-shard BATCH contract is the CNR one — explicitly NOT
+atomic.** Ops on different shards live in disjoint `key % N`
+congruence classes (`shard/ring.py`), so their sub-batches execute
+concurrently and independently: one shard's sub-batch can commit and
+ack while another's fails (`ShardUnavailable`), exactly as CNR's
+per-log batches commit independently (PAPER.md;
+`models/partitioned.py` pins the same semantics in-process).
+`execute_batch` therefore reports per-op outcomes; there is no
+cross-shard rollback. Callers that need multi-shard atomicity use the
+transaction layer ON TOP: `shard/txn.py:TxnCoordinator` drives
+presumed-abort 2PC through these same backends (the `txn_verb`
+surface routed by `txn_call`), and costs this path nothing when
+unused — see README "Keyspace sharding" for the guarantee table.
 
 Failure semantics mirror the serve plane: `ShardUnavailable` with
 `maybe_executed=False` means the sub-batch provably never reached the
@@ -43,6 +46,7 @@ adopts the bumped version, and pushes it to every backend.
 
 from __future__ import annotations
 
+import itertools
 import json
 import socket
 import threading
@@ -63,9 +67,12 @@ from node_replication_tpu.serve.errors import (
     ReplicaFailed,
     ServeError,
     ShardUnavailable,
+    TxnAborted,
+    TxnConflict,
+    TxnInDoubt,
     WrongShard,
 )
-from node_replication_tpu.shard.ring import ShardMap
+from node_replication_tpu.shard.ring import ShardMap, ShardMapCorruptError
 from node_replication_tpu.utils.clock import get_clock
 from node_replication_tpu.utils.trace import get_tracer
 
@@ -101,6 +108,15 @@ def _encode_error(e: BaseException) -> dict:
         return {"type": "NotPrimary", "rid": e.rid}
     if isinstance(e, FrontendClosed):
         return {"type": "FrontendClosed", "detail": str(e)}
+    if isinstance(e, TxnConflict):
+        return {"type": "TxnConflict", "key": e.key, "txn": e.txn}
+    if isinstance(e, TxnAborted):
+        return {"type": "TxnAborted", "txn": e.txn,
+                "detail": str(e.cause) if e.cause else ""}
+    if isinstance(e, TxnInDoubt):
+        return {"type": "TxnInDoubt", "txn": e.txn,
+                "decision": e.decision,
+                "detail": str(e.cause) if e.cause else ""}
     return {"type": "ServeError",
             "detail": f"{type(e).__name__}: {e}"}
 
@@ -126,6 +142,15 @@ def _decode_error(d: dict, shard: int) -> ServeError:
         return NotPrimary(d["rid"])
     if t == "FrontendClosed":
         return FrontendClosed(d.get("detail", "frontend closed"))
+    if t == "TxnConflict":
+        return TxnConflict(d["key"], d["txn"])
+    if t == "TxnAborted":
+        cause = RuntimeError(d["detail"]) if d.get("detail") else None
+        return TxnAborted(d["txn"], cause=cause)
+    if t == "TxnInDoubt":
+        cause = RuntimeError(d["detail"]) if d.get("detail") else None
+        return TxnInDoubt(d["txn"], decision=d.get("decision"),
+                          cause=cause)
     return ServeError(
         f"shard {shard} remote error: {d.get('detail', d)}"
     )
@@ -171,11 +196,24 @@ class LocalBackend:
     machine-checks that no shard/ submit path skips this lookup).
     """
 
-    def __init__(self, shard: int, frontend, shard_map: ShardMap):
+    def __init__(self, shard: int, frontend, shard_map: ShardMap,
+                 participant=None):
         self.shard = int(shard)
         self._frontend = frontend
         self._map = shard_map
+        #: the shard's 2PC participant (`shard/txn.py`), when wired:
+        #: routes txn verbs and fences non-txn ops off locked keys
+        self._participant = participant
         self._lock = make_lock("LocalBackend._lock")
+        # in-flight submit_batch tokens: `quiesce()` waits for the
+        # calls that entered BEFORE a map fence to leave, closing the
+        # check-then-stage window a reshard cutover must not race
+        # (`shard/reshard.py`: an op that passed the old-version check
+        # must finish acking — ship barrier armed — before the donor's
+        # shipper stops, or an acked moved-key write could miss the
+        # promote drain)
+        self._active: set = set()
+        self._active_seq = itertools.count()
 
     @property
     def map(self) -> ShardMap:
@@ -195,6 +233,41 @@ class LocalBackend:
         with self._lock:
             self._frontend = frontend
 
+    def set_participant(self, participant) -> None:
+        with self._lock:
+            self._participant = participant
+
+    @property
+    def participant(self):
+        with self._lock:
+            return self._participant
+
+    def txn_verb(self, verb: str, txn: str, gen: int,
+                 peer_version: int, ops=None,
+                 timeout: float | None = None):
+        """Dispatch one 2PC verb to this shard's participant
+        (`shard/txn.py`). The participant does its own version and
+        congruence fencing; a shard with no participant refuses
+        retryably — the coordinator re-homes via the published map
+        exactly like a dead primary."""
+        with self._lock:
+            p = self._participant
+        if p is None:
+            raise ShardUnavailable(
+                self.shard,
+                cause=RuntimeError("shard has no txn participant"),
+            )
+        if verb == "prepare":
+            return p.prepare(txn, gen, ops or [], peer_version)
+        if verb == "commit":
+            return p.commit(txn, peer_version)
+        if verb == "abort":
+            p.abort(txn, peer_version)
+            return True
+        if verb == "status":
+            return p.status(txn)
+        raise ServeError(f"unknown txn verb {verb!r}")
+
     def submit_batch(self, ops, peer_version: int,
                      deadline_s: float | None = None,
                      timeout: float | None = None,
@@ -208,9 +281,22 @@ class LocalBackend:
         per-op — an `Overloaded` shed of op k never aborts op k+1,
         matching the non-atomic contract.
         """
+        tok = next(self._active_seq)
         with self._lock:
             m = self._map
             fe = self._frontend
+            p = self._participant
+            self._active.add(tok)
+        try:
+            return self._submit_batch(m, fe, p, ops, peer_version,
+                                      deadline_s, timeout, priority,
+                                      rid)
+        finally:
+            with self._lock:
+                self._active.discard(tok)
+
+    def _submit_batch(self, m, fe, p, ops, peer_version,
+                      deadline_s, timeout, priority, rid) -> list:
         if peer_version != m.version:
             raise WrongShard(-1, self.shard, self.shard, m.version,
                              peer_version=peer_version)
@@ -219,14 +305,24 @@ class LocalBackend:
             if owner != self.shard:
                 raise WrongShard(op[1], self.shard, owner, m.version,
                                  peer_version=peer_version)
+        if p is not None and p.has_locks():
+            # a prepared-but-undecided txn blocks CONFLICTING KEYS,
+            # not the shard; the flag read above is the txn plane's
+            # entire cost on the non-txn path when nothing is in
+            # flight (the `obs_port=None` discipline)
+            p.check_conflicts(ops)
         kwargs = {} if priority is None else {"priority": priority}
 
         def translate(e: ServeError) -> ServeError:
             # a closed/dead frontend is PERMANENT for its process but
             # TRANSIENT for the shard — the op never reached the log
             # and the slice is about to be re-homed onto the promoted
-            # follower, so surface the retryable shard-plane error
-            if isinstance(e, FrontendClosed):
+            # follower, so surface the retryable shard-plane error;
+            # likewise a follower-mode frontend mid-cutover
+            # (`shard/reshard.py`: the recipient backend is attached
+            # BEFORE its promotion drains) refuses with zero effect —
+            # retryably, so closed-loop clients ride the fence out
+            if isinstance(e, (FrontendClosed, NotPrimary)):
                 return ShardUnavailable(self.shard, cause=e)
             return e
 
@@ -251,6 +347,26 @@ class LocalBackend:
             except ServeError as e:
                 pairs.append(("err", translate(e)))
         return pairs
+
+    def quiesce(self, timeout: float = 10.0) -> bool:
+        """Wait until every `submit_batch` call that entered BEFORE
+        this point has left (acked or failed). Calls entering after
+        do not extend the wait — they already see the current map, so
+        a cutover that fenced the map first only needs the OLD
+        epoch's in-flight calls gone. True when drained in time."""
+        with self._lock:
+            snap = set(self._active)
+        clock = get_clock()
+        t_end = clock.now() + float(timeout)
+        while snap:
+            with self._lock:
+                snap &= self._active
+            if not snap:
+                break
+            if clock.now() >= t_end:
+                return False
+            clock.sleep(0.002)
+        return True
 
     def close(self) -> None:
         pass
@@ -389,6 +505,33 @@ class SocketShardClient:
             )
         return _decode_pairs(rsp["pairs"], self.shard)
 
+    def txn_verb(self, verb: str, txn: str, gen: int,
+                 peer_version: int, ops=None,
+                 timeout: float | None = None):
+        """One 2PC verb over the wire (`ShardServer` routes it to the
+        shard's participant). Same post-send honesty as `submit`: a
+        connection death after the frame left raises
+        `maybe_executed=True` — but unlike a submit, commit/abort are
+        idempotent at the participant, so the coordinator MAY re-drive
+        them (and does)."""
+        rsp = self._request({
+            "kind": "txn",
+            "verb": str(verb),
+            "txn": str(txn),
+            "gen": int(gen),
+            "version": int(peer_version),
+            "ops": [list(op) for op in (ops or [])],
+            "timeout": timeout,
+        })
+        if rsp.get("kind") == "error":
+            raise _decode_error(rsp["err"], self.shard)
+        if rsp.get("kind") != "txn-ok":
+            raise ShardUnavailable(
+                self.shard,
+                cause=RuntimeError(f"bad response kind: {rsp}"),
+            )
+        return rsp.get("result")
+
     def close(self) -> None:
         with self._lock:
             self._drop_locked()
@@ -463,6 +606,11 @@ class ShardServer:
 
     def set_frontend(self, frontend) -> None:
         self._backend.set_frontend(frontend)
+
+    def set_participant(self, participant) -> None:
+        """Wire the shard's 2PC participant (`shard/txn.py`); txn
+        frames are refused (retryably) until one is attached."""
+        self._backend.set_participant(participant)
 
     # --------------------------------------------------------- serving
 
@@ -545,6 +693,21 @@ class ShardServer:
                 rid=int(req.get("rid", 0)),
             )
             return {"kind": "ack", "pairs": _encode_pairs(pairs)}
+        if kind == "txn":
+            result = self._backend.txn_verb(
+                req["verb"],
+                req["txn"],
+                int(req.get("gen", 0)),
+                int(req["version"]),
+                ops=[tuple(op) for op in req.get("ops", [])],
+                timeout=req.get("timeout"),
+            )
+            if isinstance(result, list):
+                # commit results: the models return JSON-safe scalars
+                result = [
+                    v if v is None else int(v) for v in result
+                ]
+            return {"kind": "txn-ok", "result": result}
         raise ServeError(f"unknown request kind {kind!r}")
 
     def close(self) -> None:
@@ -594,18 +757,25 @@ class ShardRouter:
         self._m_fanout = reg.histogram("shard.router.fanout_s")
         self._m_version = reg.gauge("shard.map_version")
         self._m_version.set(shard_map.version)
-        self._m_sub = {
-            s: reg.counter(f"shard.s{s}.submitted")
-            for s in range(shard_map.n_shards)
-        }
-        self._m_ack = {
-            s: reg.counter(f"shard.s{s}.acked")
-            for s in range(shard_map.n_shards)
-        }
-        self._m_reroute = {
-            s: reg.counter(f"shard.s{s}.rerouted")
-            for s in range(shard_map.n_shards)
-        }
+        self._m_corrupt = reg.counter("shard.map_corrupt")
+        # per-shard counters are created LAZILY: a reshard can grow
+        # `n_shards` mid-life (`shard/reshard.py`), and metric
+        # creation on first touch keeps the registry in step without
+        # a resize hook
+        self._m_sub: dict[int, object] = {}
+        self._m_ack: dict[int, object] = {}
+        self._m_reroute: dict[int, object] = {}
+        for s in range(shard_map.n_shards):
+            self._shard_counters(s)
+
+    def _shard_counters(self, s: int) -> tuple:
+        sub = self._m_sub.get(s)
+        if sub is None:
+            reg = get_registry()
+            sub = self._m_sub[s] = reg.counter(f"shard.s{s}.submitted")
+            self._m_ack[s] = reg.counter(f"shard.s{s}.acked")
+            self._m_reroute[s] = reg.counter(f"shard.s{s}.rerouted")
+        return sub, self._m_ack[s], self._m_reroute[s]
 
     @property
     def map(self) -> ShardMap:
@@ -632,13 +802,17 @@ class ShardRouter:
                     self._backends[int(s)] = b
             live = list(self._backends.items())
         self._m_version.set(new_map.version)
+        # growth-safe move detection: a refined map (`ShardMap.refine`)
+        # has MORE classes than the old one — a brand-new class index
+        # counts as moved only when a backend was re-homed onto it
         moved = [
             s for s in range(new_map.n_shards)
             if (backends and s in backends)
-            or new_map.addresses[s] != old.addresses[s]
+            or (s < old.n_shards
+                and new_map.addresses[s] != old.addresses[s])
         ]
         for s in moved:
-            self._m_reroute[s].inc()
+            self._shard_counters(s)[2].inc()
         tracer = get_tracer()
         if tracer.enabled and (moved or new_map.version != old.version):
             tracer.emit("serve-reroute", reason=reason,
@@ -646,6 +820,42 @@ class ShardRouter:
                         from_version=old.version, shards=moved)
         for _s, b in live:
             b.update_version(new_map)
+
+    def backend(self, shard: int):
+        """The backend currently attached for `shard` (None when
+        absent) — the reshard plan's handle for quiescing the donor
+        at its cutover fence."""
+        with self._lock:
+            return self._backends.get(int(shard))
+
+    def attach_backend(self, shard: int, backend) -> None:
+        """Register a backend WITHOUT adopting a new map — the reshard
+        cutover's staging step (`shard/reshard.py`): backends for the
+        refined classes are attached first (inert; no key routes to a
+        class beyond the current map), so the instant the doubled map
+        is adopted every class already has a home and moved-key
+        unavailability is the fence window, not a backend scramble."""
+        with self._lock:
+            prev = self._backends.get(int(shard))
+            if prev is not None and prev is not backend:
+                prev.close()
+            self._backends[int(shard)] = backend
+
+    def txn_call(self, shard: int, verb: str, txn: str, gen: int,
+                 ops=None, timeout: float | None = None):
+        """Route one 2PC verb (`shard/txn.py:TxnCoordinator`) to a
+        shard's backend under the CURRENT map version — the
+        participant fences it exactly like a submit."""
+        with self._lock:
+            m = self._map
+            backend = self._backends.get(int(shard))
+        if backend is None:
+            raise ShardUnavailable(
+                int(shard),
+                cause=RuntimeError("no backend attached"),
+            )
+        return backend.txn_verb(verb, txn, gen, m.version, ops=ops,
+                                timeout=timeout)
 
     def repoint(self, shard: int, backend,
                 new_map: ShardMap | None = None) -> ShardMap:
@@ -665,11 +875,22 @@ class ShardRouter:
         """Reload the durably-published map; adopt if newer. This is
         `call_with_retry`'s re-route hook (`WrongShard` /
         `ShardUnavailable` both trigger it). Returns True when a newer
-        version was adopted."""
+        version was adopted.
+
+        Survives a CORRUPT published map: `ShardMap.load` raises
+        typed `ShardMapCorruptError` for a document that parses or
+        validates wrong (a hand edit, bit rot — never a torn publish,
+        `durable_publish` excludes those), and the router keeps its
+        old map and counts `shard.map_corrupt` — routing on the last
+        good topology beats adopting garbage or crashing the retry
+        loop."""
         if self._map_path is None:
             return False
         try:
             m = ShardMap.load(self._map_path)
+        except ShardMapCorruptError:
+            self._m_corrupt.inc()
+            return False
         except (OSError, ValueError, KeyError):
             return False
         with self._lock:
@@ -757,7 +978,7 @@ class ShardRouter:
             backends = dict(self._backends)
         groups = m.split_batch(ops)
         for s, entries in groups.items():
-            self._m_sub[s].inc(len(entries))
+            self._shard_counters(s)[0].inc(len(entries))
         t0 = clock.now()
         by_shard = self._fan_out(m, backends, groups,
                                  deadline_s, timeout, priority, rid)
@@ -778,7 +999,7 @@ class ShardRouter:
                 elif first_err is None or idx < first_err[0]:
                     first_err = (idx, val)
             if acked:
-                self._m_ack[s].inc(acked)
+                self._shard_counters(s)[1].inc(acked)
         if first_err is not None and not return_exceptions:
             raise first_err[1]
         return out

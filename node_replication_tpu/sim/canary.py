@@ -25,6 +25,13 @@ Canaries:
   truncates the active segment to its last *actually fsynced* size,
   so the lying acks vanish and recovery comes back below the
   "durable" tail.
+- ``ack-before-decision`` — `DecisionLog.publish` silently drops the
+  decision document (ISSUE 20): the 2PC coordinator proceeds to ack
+  commit with NO durable commit point. Caught by the sharded flavor
+  as ``txn-atomicity`` on a crash-variant ``stxn`` step: the
+  coordinator dies right after its (now vanished) decision publish,
+  and restart recovery presumed-aborts a transaction the coordinator
+  had decided to commit.
 """
 
 from __future__ import annotations
@@ -65,9 +72,26 @@ def _ack_before_fsync():
         wal_mod.WriteAheadLog.sync = orig
 
 
+@contextlib.contextmanager
+def _ack_before_decision():
+    from node_replication_tpu.durable import txnlog as txnlog_mod
+
+    orig = txnlog_mod.DecisionLog.publish
+
+    def lost_publish(self, txn, outcome, shards=()):
+        return None  # the bug: the decision never reaches disk
+
+    txnlog_mod.DecisionLog.publish = lost_publish
+    try:
+        yield
+    finally:
+        txnlog_mod.DecisionLog.publish = orig
+
+
 CANARIES = {
     "reclaim-ignores-pins": _reclaim_ignores_pins,
     "ack-before-fsync": _ack_before_fsync,
+    "ack-before-decision": _ack_before_decision,
 }
 
 #: the flavor whose property set catches each canary — `explore.py
@@ -75,6 +99,7 @@ CANARIES = {
 CANARY_FLAVOR = {
     "reclaim-ignores-pins": "repl",
     "ack-before-fsync": "crash",
+    "ack-before-decision": "sharded",
 }
 
 

@@ -36,7 +36,13 @@ Flavors:
   kill → typed-unavailability window → promotion → router re-home
   tail. Generated entirely from a FRESH rng stream, so every other
   flavor's schedule (and the canary-seed expectations) stays
-  byte-identical.
+  byte-identical. Grown by ISSUE 20 (more fresh streams): ``stxn``
+  steps drive atomic cross-shard transactions through the REAL
+  `TxnCoordinator` (optionally killing the coordinator right after
+  its durable decision publish and simulating the restart recovery),
+  and no-kill runs may end in an ``sreshard`` step — a live split of
+  one congruence class onto the donor's promoted standby, with
+  post-split traffic across the refined topology.
 
 Property catalog (each violation carries the property name):
 
@@ -74,6 +80,15 @@ Property catalog (each violation carries the property name):
   ``resp-diff`` (per-shard oracle), ``durable-ack-survival`` (a
   promotion lost a shipped-acked op), ``zombie-unfenced``, and
   ``log-content`` (lost/duplicated acks per shard).
+- ``txn-atomicity``      — a cross-shard transaction half-applied: an
+  acked txn op whose response (or read-back) diverges from the
+  per-class oracle, an aborted txn with a visible per-key effect, or
+  a DECIDED txn (the coordinator crashed one instruction after its
+  durable decision publish) that restart recovery fails to re-drive
+  to commit on every participant.
+- ``reshard-exactness``  — after a live split, some key's read-back
+  is not the fold of exactly its class's acked ops (a moved key lost,
+  duplicated, or served from the wrong slice).
 
 The serve flavor's ``burst`` steps drive the overload plane
 deterministically: a paused frontend (workers not started) admits a
@@ -464,7 +479,9 @@ def _generate_sharded(seed: int, srng: random.Random,
             steps.append(["sship", srng.randrange(n_shards)])
         else:
             steps.append(["sapply", srng.randrange(n_shards)])
-    if srng.random() < 0.7:
+    body_end = len(steps)
+    killed = srng.random() < 0.7
+    if killed:
         # kill → typed-unavailability window (writes keyed into the
         # victim's congruence class surface `ShardUnavailable`; the
         # survivor keeps acking — the isolation half of the property)
@@ -484,6 +501,40 @@ def _generate_sharded(seed: int, srng: random.Random,
     else:
         for s in range(n_shards):
             steps += [["swal", s], ["sship", s], ["sapply", s]]
+    # cross-shard transactions + online resharding (ISSUE 20): drawn
+    # from ANOTHER fresh stream, so every pre-txn sharded schedule
+    # (and the existing canary expectations) stays byte-identical.
+    # Txn steps insert only into the pre-kill body — the coordinator
+    # is exercised against live shards; the kill window's typed
+    # unavailability is the abort path, covered by crafted tests.
+    trng = random.Random(int(seed) ^ 0x77C27)
+    tuniq = 50_000  # disjoint from the wop() uniq range
+    if trng.random() < 0.65:
+        txn_steps = []
+        for _ in range(trng.randrange(1, 3)):
+            # adjacent keys straddle the mod-2 congruence: the txn is
+            # genuinely cross-shard, so the 2PC path (not the
+            # single-group fast path) is what runs
+            k0 = trng.randrange(size - 1)
+            ops = [[1, k0, tuniq], [1, k0 + 1, tuniq + 1]]
+            tuniq += 2
+            if trng.random() < 0.5:
+                ops.append([1, trng.randrange(size), tuniq])
+                tuniq += 1
+            # crash=1: the coordinator dies right after its durable
+            # decision publish — recovery must re-drive the commit
+            txn_steps.append(["stxn", ops,
+                              int(trng.random() < 0.4)])
+        for st in reversed(txn_steps):
+            steps.insert(trng.randrange(0, body_end + 1), st)
+    if not killed and trng.random() < 0.5:
+        # live split of one congruence class (no-kill runs only: the
+        # donor needs a promotable standby), then post-split traffic
+        # across the refined topology
+        steps.append(["sreshard", trng.randrange(n_shards)])
+        for _ in range(trng.randrange(2, 5)):
+            steps.append(["sw", [1, trng.randrange(size), tuniq]])
+            tuniq += 1
     return CaseSpec(seed, model, "nr", "sharded", 1, 1, steps,
                     n_shards=n_shards)
 
@@ -557,6 +608,14 @@ class _Run:
         self.sh_promoted: list = []
         self.sh_pre_cursor: list = []
         self.sh_acked: list = []  # shipped-acked floor at kill time
+        # cross-shard txn + reshard plumbing (ISSUE 20)
+        self.decisions = None  # DecisionLog shared by the fleet
+        self.coord = None  # TxnCoordinator, built on first stxn
+        self.sh_txn: list = []  # per-shard TxnParticipant
+        self.sh_txn_extra: list = []  # refined-class participants
+        self.resharded = False
+        self.recipient = None  # the promoted donor follower
+        self.reshard_donor = -1
 
     # ------------------------------------------------------------ plumbing
 
@@ -693,9 +752,15 @@ class _Run:
             ShardRouter,
         )
 
+        from node_replication_tpu.durable.txnlog import DecisionLog
+        from node_replication_tpu.shard.txn import TxnParticipant
+
         spec = self.spec
         self.tmp = tempfile.mkdtemp(prefix="nr-sim-")
         self.smap = ShardMap(spec.n_shards)
+        self.decisions = DecisionLog(
+            os.path.join(self.tmp, "decisions")
+        )
         backends: dict = {}
         for s in range(spec.n_shards):
             base = os.path.join(self.tmp, f"s{s}")
@@ -732,7 +797,13 @@ class _Run:
             self.shards.append({"nr": nr, "wal": wal, "feed": feed,
                                 "shipper": shipper, "fe": fe,
                                 "follower": follower})
-            backends[s] = LocalBackend(s, fe, self.smap)
+            txn = TxnParticipant(
+                s, fe, self.smap, os.path.join(base, "txn"),
+                decisions=self.decisions, wal=wal,
+            )
+            self.sh_txn.append(txn)
+            backends[s] = LocalBackend(s, fe, self.smap,
+                                       participant=txn)
             self.sh_oracle.append(
                 make_oracle(spec.model, MODEL_SIZES[spec.model])
             )
@@ -746,6 +817,11 @@ class _Run:
                                   concurrent=False)
 
     def _teardown(self):
+        for t in self.sh_txn + self.sh_txn_extra:
+            try:
+                t.close()
+            except Exception:
+                pass
         for sh in self.shards:
             try:
                 sh["fe"].close(drain=False)
@@ -1315,6 +1391,26 @@ class _Run:
     def _shard_of(self, op: list) -> int:
         return self.smap.shard_of_op(tuple(op))
 
+    def _class_fe(self, c: int):
+        """The serving frontend for congruence class `c` — a base
+        shard's primary (or its promoted follower), an alias class
+        riding its base shard after a split, or the split recipient."""
+        n0 = len(self.shards)
+        if c >= n0:
+            d = c - n0
+            if d == self.reshard_donor:
+                return self.recipient.frontend
+            sh = self.shards[d]
+            return (sh["follower"].frontend if self.sh_promoted[d]
+                    else sh["fe"])
+        sh = self.shards[c]
+        return (sh["follower"].frontend if self.sh_promoted[c]
+                else sh["fe"])
+
+    def _participants(self) -> list:
+        return [t for t in self.sh_txn + self.sh_txn_extra
+                if t is not None]
+
     def _fold_shard_ack(self, i: int, s: int, op: list,
                         resp) -> None:
         """Fold one router-acked op into shard `s`'s oracle. Keys are
@@ -1357,9 +1453,7 @@ class _Run:
 
     def do_sread(self, i: int, op: list) -> None:
         s = self._shard_of(op)
-        sh = self.shards[s]
-        fe = (sh["follower"].frontend if self.sh_promoted[s]
-              else sh["fe"])
+        fe = self._class_fe(s)
         try:
             val = fe.read(tuple(op), rid=0)
         except Exception as e:
@@ -1484,8 +1578,207 @@ class _Run:
             new_map=new_map,
         )
         self.smap = new_map
+        for t in self._participants():
+            t.set_map(new_map)
+        if s < len(self.sh_txn):
+            self.sh_txn[s].set_frontend(sh["follower"].frontend,
+                                        wal=sh["follower"].nr.wal)
         self.ev(i, "spromote", shard=s, applied=applied, epoch=epoch,
                 map_version=int(new_map.version))
+
+    # ------------------------------------------------------- txn steps
+
+    def do_stxn(self, i: int, ops: list, crash: int) -> None:
+        """One atomic cross-shard transaction through the REAL
+        `TxnCoordinator` (presumed-abort 2PC over the sim's backends,
+        intents/decisions on the case's tmp dir). `crash=1` kills the
+        coordinator at the `txn-decide` fault site — one instruction
+        AFTER its durable decision publish — then simulates the
+        restart: epoch bump + every participant resolving in-doubt
+        state from the decision log. Property ``txn-atomicity``: a
+        decided txn re-drives to commit on every shard; an aborted
+        one leaves ZERO per-key effect."""
+        if self.router is None:
+            self.ev(i, "stxn-skip")
+            return
+        if self.coord is None:
+            from node_replication_tpu.shard.txn import TxnCoordinator
+
+            self.coord = TxnCoordinator(
+                self.router, os.path.join(self.tmp, "decisions"),
+                name="sim",
+            )
+        tops = [tuple(op) for op in ops]
+        shards = sorted({self._shard_of(list(op)) for op in tops})
+        plan = (self._one_shot_plan("txn-decide", "raise")
+                if crash else None)
+        err = None
+        results = None
+        try:
+            if plan is not None:
+                with plan.armed():
+                    results = self.coord.execute_txn(tops)
+            else:
+                results = self.coord.execute_txn(tops)
+        except Exception as e:
+            err = e
+        if err is not None and plan is not None and plan.fired:
+            # the coordinator REACHED its decision point (the fault
+            # site sits one line past the durable publish), so the
+            # commit is decided: restart recovery must re-drive it —
+            # a resolve to anything else means the decision record
+            # was lost (the ack-before-decision bug class)
+            epoch = self.decisions.bump_epoch()
+            outcomes: dict = {}
+            for t in self._participants():
+                outcomes.update(t.resolve_in_doubt(
+                    decisions=self.decisions, epoch=epoch))
+            self.coord = None  # the old generation died with it
+            if set(outcomes.values()) != {"commit"}:
+                self.vio("txn-atomicity", i,
+                         f"decided txn resolved {outcomes} after "
+                         f"coordinator restart — the durable commit "
+                         f"decision did not survive")
+                self.ev(i, "stxn-lost", shards=shards)
+                return
+            for op in tops:
+                s = self._shard_of(list(op))
+                self.sh_oracle[s].apply(list(op))
+                self.sh_applied[s].append(list(op))
+            self.ev(i, "stxn-recovered", shards=shards)
+            return
+        if err is not None:
+            # aborted (conflict / unavailability / in-doubt before
+            # the decision): atomicity demands ZERO visible effect —
+            # read every touched key back through its serving path
+            for op in tops:
+                s = self._shard_of(list(op))
+                try:
+                    val = self._class_fe(s).read(
+                        (1, int(op[1]), 0), rid=0)
+                except Exception:
+                    continue  # dead shard: nothing readable to leak
+                expect = self.sh_oracle[s].read([1, int(op[1]), 0])
+                if int(val) != int(expect):
+                    self.vio("txn-atomicity", i,
+                             f"aborted txn left key {int(op[1])} = "
+                             f"{int(val)} (expected {int(expect)})")
+            self.ev(i, "stxn-abort", err=type(err).__name__,
+                    shards=shards)
+            return
+        for op, r in zip(tops, results):
+            s = self._shard_of(list(op))
+            expect = self.sh_oracle[s].apply(list(op))
+            self.sh_applied[s].append(list(op))
+            if int(r) != int(expect):
+                self.vio("txn-atomicity", i,
+                         f"txn op {list(op)} -> {int(r)}, oracle "
+                         f"{int(expect)}")
+        self.ev(i, "stxn", shards=shards,
+                resps=[int(r) for r in results])
+
+    def do_sreshard(self, i: int, donor: int) -> None:
+        """Live split of class `donor` (mod N) into `{donor,
+        donor+N}` (mod 2N), mirroring `shard/reshard.py`: catch the
+        standby up, stage backends (+ participants) for every refined
+        class, adopt the refined map, promote the follower into the
+        moved class. Per-class bookkeeping refolds under the new
+        congruence; the end-of-case check for resharded runs is
+        ``reshard-exactness`` (global per-key read-back)."""
+        from node_replication_tpu.shard.router import LocalBackend
+        from node_replication_tpu.shard.txn import TxnParticipant
+
+        donor = int(donor)
+        if (self.resharded or donor >= len(self.shards)
+                or self.sh_dead[donor] or self.sh_promoted[donor]):
+            self.ev(i, "sreshard-skip", donor=donor)
+            return
+        sh = self.shards[donor]
+        # catch-up: cooperative stepping stands in for the background
+        # ship/apply lanes (bounded — the history is finite)
+        target = len(self.sh_applied[donor])
+        for _ in range(200):
+            if int(sh["follower"].applied_pos()) >= target:
+                break
+            sh["nr"].wal_sync()
+            sh["shipper"]._ship_once()
+            sh["follower"]._apply_once()
+        if int(sh["follower"].applied_pos()) < target:
+            self.vio("replication-gap", i,
+                     f"shard {donor} standby stuck at "
+                     f"{sh['follower'].applied_pos()} < {target}")
+            return
+        n0 = len(self.shards)
+        moved = donor + n0
+        new_map = self.smap.refine()
+        for d in range(n0):
+            if d == donor:
+                continue
+            q = self.shards[d]
+            t = TxnParticipant(
+                d + n0, q["fe"], new_map,
+                os.path.join(self.tmp, f"r{d + n0}", "txn"),
+                decisions=self.decisions, wal=q["wal"],
+            )
+            self.sh_txn_extra.append(t)
+            self.router.attach_backend(
+                d + n0,
+                LocalBackend(d + n0, q["fe"], new_map,
+                             participant=t),
+            )
+        rt = TxnParticipant(
+            moved, sh["follower"].frontend, new_map,
+            os.path.join(self.tmp, f"r{moved}", "txn"),
+            decisions=self.decisions, wal=sh["follower"].nr.wal,
+        )
+        self.sh_txn_extra.append(rt)
+        self.router.attach_backend(
+            moved,
+            LocalBackend(moved, sh["follower"].frontend, new_map,
+                         participant=rt),
+        )
+        self.router.adopt(new_map, reason=f"sim-split-s{donor}")
+        try:
+            rep = sh["follower"].promote()
+        except Exception as e:
+            self.vio("replication-gap", i,
+                     f"split promotion failed: "
+                     f"{type(e).__name__}: {e}")
+            return
+        applied = int(rep["applied"])
+        if applied < target:
+            self.vio("reshard-exactness", i,
+                     f"recipient promoted at {applied} < acked "
+                     f"history {target}")
+        # refold the per-class bookkeeping under the refined
+        # congruence: per-shard order is preserved and classes are
+        # disjoint, so the refined folds are exact
+        C = 2 * n0
+        old_applied = self.sh_applied
+        self.sh_applied = [[] for _ in range(C)]
+        for s in range(n0):
+            for op in old_applied[s]:
+                c = new_map.shard_of_op(tuple(op))
+                self.sh_applied[c].append(op)
+        self.sh_oracle = [
+            make_oracle(self.spec.model, MODEL_SIZES[self.spec.model])
+            for _ in range(C)
+        ]
+        for c in range(C):
+            for op in self.sh_applied[c]:
+                self.sh_oracle[c].apply(op)
+        self.sh_dead += [False] * n0
+        self.sh_promoted += [False] * n0
+        self.sh_pre_cursor += [0] * n0
+        self.sh_acked += [0] * n0
+        self.smap = new_map
+        for t in self._participants():
+            t.set_map(new_map)
+        self.resharded = True
+        self.recipient = sh["follower"]
+        self.reshard_donor = donor
+        self.ev(i, "sreshard", donor=donor, moved=moved,
+                map_version=int(new_map.version), applied=applied)
 
     # ---------------------------------------------------------- end state
 
@@ -1556,6 +1849,29 @@ class _Run:
                 return
 
     def _finalize_sharded(self) -> None:
+        if self.resharded:
+            # the refined classes interleave the donor's pre-split
+            # records across two histories, so the per-shard ring and
+            # array checks no longer apply — the reshard contract is
+            # GLOBAL read-back exactness: every key serves the fold
+            # of exactly its class's acked ops (zero lost, zero
+            # duplicated, zero re-homed into the wrong slice)
+            size = MODEL_SIZES[self.spec.model]
+            for k in range(size):
+                c = self.smap.shard_of(k)
+                try:
+                    val = self._class_fe(c).read((1, k, 0), rid=0)
+                except Exception as e:
+                    self.vio("reshard-exactness", -1,
+                             f"key {k} (class {c}) unreadable after "
+                             f"split: {type(e).__name__}")
+                    continue
+                expect = self.sh_oracle[c].read([1, k, 0])
+                if int(val) != int(expect):
+                    self.vio("reshard-exactness", -1,
+                             f"key {k} (class {c}) -> {int(val)}, "
+                             f"fold of acked ops {int(expect)}")
+            return
         for s in range(self.spec.n_shards):
             sh = self.shards[s]
             if self.sh_promoted[s]:
@@ -1724,6 +2040,11 @@ def run_case(spec: CaseSpec) -> CaseResult:
                     run.do_spromote(i, int(step[1]))
                 elif kind == "szombie":
                     run.do_sship(i, int(step[1]), zombie=True)
+                elif kind == "stxn":
+                    run.do_stxn(i, [list(o) for o in step[1]],
+                                int(step[2]))
+                elif kind == "sreshard":
+                    run.do_sreshard(i, int(step[1]))
                 else:
                     raise ValueError(f"unknown step kind {kind!r}")
             run.finalize()

@@ -1629,3 +1629,80 @@ class TestUnroutedKeyInShardPath:
                     return fe.submit(op).result()
         """)
         assert not firing(diags, "unrouted-key-in-shard-path")
+
+
+class TestTxnAckBeforeDecision:
+    def _lint_in(self, tmp_path, subdir, source):
+        import textwrap
+        d = tmp_path / subdir
+        d.mkdir(parents=True, exist_ok=True)
+        p = d / "snippet.py"
+        p.write_text(textwrap.dedent(source))
+        diags, errors = run_lint([str(p)])
+        assert not errors, errors
+        return diags
+
+    def test_ack_without_decision_fires(self, tmp_path):
+        # the lost-commit-point bug: the coordinator resolves the
+        # caller's future after prepare with NO durable decision — a
+        # crash right after the ack presumed-aborts a transaction the
+        # caller was told committed
+        diags = self._lint_in(tmp_path, "shard", """
+            class Coordinator:
+                def run(self, txn, groups, fut):
+                    for shard, ops in groups.items():
+                        self._backend(shard).prepare(txn, self.gen, ops)
+                    results = self._commit_all(txn, groups)
+                    fut.set_result(results)
+        """)
+        assert len(firing(diags, "txn-ack-before-decision")) == 1
+
+    def test_verb_string_dispatch_fires(self, tmp_path):
+        # the prepare step hidden behind a verb-string dispatch
+        # helper is still the prepare step
+        diags = self._lint_in(tmp_path, "shard", """
+            class Coordinator:
+                def run(self, txn, groups, fut):
+                    for shard, ops in groups.items():
+                        self._verb(shard, "prepare", txn, ops=ops)
+                    fut.set_result(self._commit_all(txn, groups))
+        """)
+        assert len(firing(diags, "txn-ack-before-decision")) == 1
+
+    def test_decision_before_ack_clean(self, tmp_path):
+        # the sanctioned shape (shard/txn.py TxnCoordinator): the
+        # decision document is durably published BEFORE any future
+        # resolves
+        diags = self._lint_in(tmp_path, "shard", """
+            class Coordinator:
+                def run(self, txn, groups, fut):
+                    for shard, ops in groups.items():
+                        self._backend(shard).prepare(txn, self.gen, ops)
+                    self.decisions.publish(txn, "commit",
+                                           shards=sorted(groups))
+                    fut.set_result(self._commit_all(txn, groups))
+        """)
+        assert not firing(diags, "txn-ack-before-decision")
+
+    def test_set_exception_exempt(self, tmp_path):
+        # failing the caller never claims the transaction decided
+        diags = self._lint_in(tmp_path, "shard", """
+            class Coordinator:
+                def run(self, txn, groups, fut):
+                    try:
+                        for shard, ops in groups.items():
+                            self._backend(shard).prepare(txn, 0, ops)
+                    except Exception as e:
+                        fut.set_exception(e)
+        """)
+        assert not firing(diags, "txn-ack-before-decision")
+
+    def test_outside_shard_clean(self, tmp_path):
+        # only the shard/ txn plane carries the 2PC contract
+        diags = self._lint_in(tmp_path, "serve", """
+            class Pipeline:
+                def run(self, stage, fut):
+                    stage.prepare()
+                    fut.set_result(stage.flush())
+        """)
+        assert not firing(diags, "txn-ack-before-decision")

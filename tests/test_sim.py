@@ -478,3 +478,103 @@ class TestPipelineOverlapKnob:
         d.pop("overlap")
         loaded = CaseSpec.from_dict(d)
         assert loaded.overlap == 0
+
+
+class TestTxnAndReshardSteps:
+    def test_generated_txn_case_clean_and_deterministic(self):
+        # ISSUE 20: a generated sharded case driving the real 2PC
+        # coordinator (including a crash-variant stxn: coordinator
+        # dies right after its decision publish, restart recovery
+        # re-drives the commit) holds every property and replays
+        # byte-identically
+        spec = _find_spec(
+            lambda s: any(st[0] == "stxn" and st[2]
+                          for st in s.steps),
+            flavors=("sharded",),
+        )
+        r1 = run_case(spec)
+        assert r1.ok, [v.as_dict() for v in r1.violations]
+        kinds = {e[1] for e in r1.events}
+        assert kinds & {"stxn", "stxn-recovered", "stxn-abort"}
+        r2 = run_case(spec)
+        assert r1.digest == r2.digest
+
+    def test_generated_reshard_case_clean_and_deterministic(self):
+        spec = _find_spec(
+            lambda s: any(st[0] == "sreshard" for st in s.steps),
+            flavors=("sharded",),
+        )
+        r1 = run_case(spec)
+        assert r1.ok, [v.as_dict() for v in r1.violations]
+        assert any(e[1] == "sreshard" for e in r1.events)
+        r2 = run_case(spec)
+        assert r1.digest == r2.digest
+
+    def test_crafted_txn_across_split_topology(self):
+        # seed both classes, split shard 0 live, then run a txn whose
+        # keys span the REFINED topology (classes 1 and 2 of 4) and
+        # read everything back — the global-exactness finalize
+        steps = [
+            ["sw", [1, 0, 11]],
+            ["sw", [1, 2, 12]],                       # moved class
+            ["sw", [1, 1, 13]],
+            ["stxn", [[1, 4, 21], [1, 5, 22]], 0],    # cross-shard
+            ["sreshard", 0],
+            ["sw", [1, 2, 31]],                       # lands on recipient
+            ["stxn", [[1, 1, 41], [1, 2, 42]], 0],    # classes 1 + 2
+            ["sread", [1, 2, 0]],
+        ]
+        spec = CaseSpec(
+            seed=0, model="hashmap", wrapper="nr", flavor="sharded",
+            n_replicas=1, nlogs=1, steps=steps, n_shards=2,
+        )
+        res = run_case(spec)
+        assert res.ok, [v.as_dict() for v in res.violations]
+        by_step = {e[0]: e for e in res.events}
+        assert by_step[3][1] == "stxn"
+        assert by_step[3][2]["shards"] == [0, 1]
+        assert by_step[4][1] == "sreshard"
+        assert by_step[4][2]["moved"] == 2
+        assert by_step[4][2]["map_version"] == 2
+        assert by_step[6][1] == "stxn"
+        assert by_step[6][2]["shards"] == [1, 2]
+        assert by_step[7][2] == {"shard": 2, "val": 42}
+
+    def test_crafted_txn_abort_in_kill_window_is_atomic(self):
+        # a txn spanning a dead shard aborts whole: the survivor's
+        # key must show ZERO effect (the read-back the txn-atomicity
+        # property runs at the abort site)
+        steps = [
+            ["sw", [1, 1, 11]],
+            ["skill", 0],
+            ["stxn", [[1, 1, 21], [1, 2, 22]], 0],
+            ["sread", [1, 1, 0]],
+        ]
+        spec = CaseSpec(
+            seed=0, model="hashmap", wrapper="nr", flavor="sharded",
+            n_replicas=1, nlogs=1, steps=steps, n_shards=2,
+        )
+        res = run_case(spec)
+        assert res.ok, [v.as_dict() for v in res.violations]
+        by_step = {e[0]: e for e in res.events}
+        assert by_step[2][1] == "stxn-abort"
+        assert by_step[3][2] == {"shard": 1, "val": 11}
+
+    def test_ack_before_decision_canary_is_caught(self):
+        # the re-injectable ISSUE 20 bug: DecisionLog.publish drops
+        # the document, so a decided txn presumed-aborts on restart
+        with canary.armed("ack-before-decision"):
+            spec = _find_spec(
+                lambda s: any(st[0] == "stxn" and st[2]
+                              for st in s.steps),
+                flavors=("sharded",),
+            )
+            res = run_case(spec)
+            assert any(v.prop == "txn-atomicity"
+                       for v in res.violations), (
+                "canary survived",
+                [v.as_dict() for v in res.violations])
+            replay = run_case(spec)
+            assert replay.digest == res.digest
+        # disarmed: the same spec runs clean
+        assert run_case(spec).ok

@@ -1,0 +1,523 @@
+"""Atomic cross-shard transactions + online resharding (ISSUE 20).
+
+The contracts under test:
+
+- `TxnIntentLog` / `DecisionLog`: CRC-framed fsynced intent journal
+  (torn tail truncates, complete-bad-CRC raises typed corruption),
+  durable decision publish, durable coordinator epoch.
+- `TxnParticipant`: the fsynced intent IS the yes-vote; prepared
+  intents lock conflicting KEYS (not the shard); commit/abort are
+  idempotent and version-fenced; recovery resolves by decision
+  lookup with presumed abort for dead generations; the commit-begin
+  WAL fence makes crash-mid-commit replay exactly-once.
+- `TxnCoordinator`: durable decision publish BEFORE any result
+  resolves; all-or-nothing across shards; single-shard degrade costs
+  zero 2PC; restart re-drives published commits.
+- `ReshardPlan`: a live split moves a congruence class onto the
+  donor's promoted follower with zero lost acks and a fence-window
+  (not state-sized) unavailability; merge folds it back by history
+  replay.
+"""
+
+import json
+import os
+import struct
+import threading
+import time
+
+import pytest
+
+from node_replication_tpu.durable import (
+    DecisionLog,
+    TxnIntentLog,
+    TxnLogCorruptError,
+)
+from node_replication_tpu.fault.inject import FaultPlan, FaultSpec
+from node_replication_tpu.models import HM_GET, HM_PUT, make_hashmap
+from node_replication_tpu.serve import (
+    RetryPolicy,
+    ServeError,
+    TxnAborted,
+    TxnConflict,
+    call_with_retry,
+)
+from node_replication_tpu.serve.errors import WrongShard
+from node_replication_tpu.shard import (
+    ReshardError,
+    ReshardPlan,
+    ShardGroup,
+)
+
+NR_KW = dict(n_replicas=1, log_entries=1 << 10, gc_slack=32)
+
+
+def _group(tmp_path, n=2, **kw):
+    kw.setdefault("nr_kwargs", NR_KW)
+    kw.setdefault("concurrent_router", False)
+    return ShardGroup(n, make_hashmap(256), str(tmp_path), **kw)
+
+
+def _read(g, k):
+    s = g.map.shard_of(k)
+    return int(g.primaries[s].live_frontend.read((HM_GET, k)))
+
+
+# ==========================================================================
+# the durable layer: intent journal + decision log
+# ==========================================================================
+
+
+class TestTxnIntentLog:
+    def test_journal_and_reopen_rebuilds_unresolved(self, tmp_path):
+        p = str(tmp_path / "txn-intents.log")
+        log = TxnIntentLog(p)
+        log.journal_intent("t1", 1, [(HM_PUT, 2, 9)])
+        log.journal_intent("t2", 1, [(HM_PUT, 4, 9)])
+        log.journal_resolved("t2", "abort")
+        log.close()
+        log2 = TxnIntentLog(p)
+        unres = log2.unresolved()
+        assert list(unres) == ["t1"]
+        assert unres["t1"]["ops"] == [(HM_PUT, 2, 9)]
+        assert log2.outcome("t2") == "abort"
+        log2.close()
+
+    def test_torn_tail_truncates_silently(self, tmp_path):
+        p = str(tmp_path / "txn-intents.log")
+        log = TxnIntentLog(p)
+        log.journal_intent("t1", 1, [(HM_PUT, 2, 9)])
+        log.close()
+        good = os.path.getsize(p)
+        with open(p, "ab") as f:
+            f.write(struct.pack("<II", 4096, 0) + b"par")  # torn record
+        log2 = TxnIntentLog(p)
+        assert list(log2.unresolved()) == ["t1"]
+        log2.close()
+        assert os.path.getsize(p) == good  # debris truncated away
+
+    def test_complete_bad_crc_is_typed_corruption(self, tmp_path):
+        p = str(tmp_path / "txn-intents.log")
+        log = TxnIntentLog(p)
+        log.journal_intent("t1", 1, [(HM_PUT, 2, 9)])
+        log.close()
+        payload = b'{"kind": "garbage"}'
+        with open(p, "ab") as f:  # complete frame, wrong checksum
+            f.write(struct.pack("<II", len(payload), 1234) + payload)
+        with pytest.raises(TxnLogCorruptError):
+            TxnIntentLog(p)
+
+    def test_commit_begin_round_trips(self, tmp_path):
+        p = str(tmp_path / "txn-intents.log")
+        log = TxnIntentLog(p)
+        log.journal_intent("t1", 3, [(HM_PUT, 2, 9)])
+        log.journal_commit_begin("t1", 17)
+        log.close()
+        log2 = TxnIntentLog(p)
+        assert log2.unresolved()["t1"]["commit_begin"] == 17
+        log2.close()
+
+
+class TestDecisionLog:
+    def test_publish_load_and_absence(self, tmp_path):
+        d = DecisionLog(str(tmp_path))
+        assert d.load("nope") is None  # absence != corruption
+        d.publish("t1", "commit", shards=(0, 2))
+        rec = d.load("t1")
+        assert rec["outcome"] == "commit"
+        assert list(rec["shards"]) == [0, 2]
+        assert d.outcome("t1") == "commit"
+
+    def test_epoch_bumps_are_durable(self, tmp_path):
+        d = DecisionLog(str(tmp_path))
+        assert d.epoch() == 0
+        assert d.bump_epoch() == 1
+        assert DecisionLog(str(tmp_path)).epoch() == 1
+
+    def test_corrupt_decision_is_typed(self, tmp_path):
+        d = DecisionLog(str(tmp_path))
+        d.publish("t1", "commit")
+        path = [os.path.join(str(tmp_path), f)
+                for f in os.listdir(str(tmp_path)) if "t1" in f][0]
+        with open(path, "w") as f:
+            f.write("{not json")
+        with pytest.raises(TxnLogCorruptError):
+            d.load("t1")
+
+
+# ==========================================================================
+# participant semantics (through a ShardGroup's wiring)
+# ==========================================================================
+
+
+class TestParticipant:
+    def test_prepared_intent_locks_keys_not_shard(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            g.router.txn_call(0, "prepare", "c.g1.1", 1,
+                              ops=[(HM_PUT, 2, 9)])
+            # the locked KEY conflicts, with zero log effect...
+            with pytest.raises(TxnConflict) as ei:
+                g.router.call((HM_PUT, 2, 5))
+            assert ei.value.retryable and not ei.value.maybe_executed
+            # ...but the shard keeps serving every other key
+            assert int(g.router.call((HM_PUT, 4, 44))) >= 0
+            assert _read(g, 4) == 44
+        finally:
+            g.close()
+
+    def test_commit_applies_and_releases(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            g.router.txn_call(0, "prepare", "c.g1.1", 1,
+                              ops=[(HM_PUT, 2, 9)])
+            g.router.txn_call(0, "commit", "c.g1.1", 1)
+            assert _read(g, 2) == 9
+            assert int(g.router.call((HM_PUT, 2, 10))) >= 0  # unlocked
+            # idempotent re-drive: no second apply, empty results
+            assert g.router.txn_call(0, "commit", "c.g1.1", 1) == []
+            assert _read(g, 2) == 10
+        finally:
+            g.close()
+
+    def test_abort_is_zero_effect_and_idempotent(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            g.router.txn_call(0, "prepare", "c.g1.1", 1,
+                              ops=[(HM_PUT, 2, 9)])
+            g.router.txn_call(0, "abort", "c.g1.1", 1)
+            assert _read(g, 2) == -1  # never applied (absent key)
+            g.router.txn_call(0, "abort", "c.g1.1", 1)  # no-op
+            g.router.txn_call(0, "abort", "never-prepared", 1)  # no-op
+            with pytest.raises(ServeError):
+                g.router.txn_call(0, "commit", "c.g1.1", 1)
+        finally:
+            g.close()
+
+    def test_stale_version_fenced_at_every_verb(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            p = g.primaries[0].txn
+            with pytest.raises(WrongShard):
+                p.prepare("c.g1.1", 1, [(HM_PUT, 2, 9)],
+                          peer_version=g.map.version + 1)
+            p.prepare("c.g1.1", 1, [(HM_PUT, 2, 9)], g.map.version)
+            with pytest.raises(WrongShard):
+                p.commit("c.g1.1", peer_version=g.map.version + 1)
+        finally:
+            g.close()
+
+    def test_misrouted_op_in_prepare_is_wrong_shard(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            with pytest.raises(WrongShard):
+                g.router.txn_call(0, "prepare", "c.g1.1", 1,
+                                  ops=[(HM_PUT, 3, 9)])  # key 3 -> s1
+        finally:
+            g.close()
+
+    def test_restart_rebuilds_locks_and_presumes_abort(self, tmp_path):
+        g = _group(tmp_path)
+        coord = g.coordinator()
+        g.router.txn_call(0, "prepare", f"x.g{coord.gen}.1", coord.gen,
+                          ops=[(HM_PUT, 2, 9)])
+        g.close()
+        g2 = _group(tmp_path, recover=True)
+        try:
+            # reopened journal rebuilt the lock...
+            with pytest.raises(TxnConflict):
+                g2.router.call((HM_PUT, 2, 5))
+            # ...a NEW coordinator generation makes the old intent
+            # presumed-abortable, which releases it
+            g2.coordinator()
+            res = g2.resolve_in_doubt()
+            assert res[0][f"x.g{coord.gen}.1"] == "abort"
+            assert int(g2.router.call((HM_PUT, 2, 5))) >= 0
+            assert _read(g2, 2) == 5  # the prepared 9 never applied
+        finally:
+            g2.close()
+
+    def test_live_generation_stays_in_doubt(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            coord = g.coordinator()
+            txn = f"{coord.name}.g{coord.gen}.7"
+            g.router.txn_call(0, "prepare", txn, coord.gen,
+                              ops=[(HM_PUT, 2, 9)])
+            res = g.resolve_in_doubt()
+            assert res[0][txn] == "in-doubt"
+            with pytest.raises(TxnConflict):  # keys stay locked
+                g.router.call((HM_PUT, 2, 5))
+        finally:
+            g.close()
+
+    def test_crash_mid_commit_replays_exactly_once(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            coord = g.coordinator()
+            txn = f"{coord.name}.g{coord.gen}.1"
+            g.router.txn_call(0, "prepare", txn, coord.gen,
+                              ops=[(HM_PUT, 2, 9), (HM_PUT, 4, 11)])
+            g.decisions.publish(txn, "commit", shards=(0,))
+            plan = FaultPlan([FaultSpec(site="txn-commit",
+                                        action="raise", rid=0)])
+            with plan.armed():
+                with pytest.raises(Exception):
+                    # applies BOTH ops, then dies before the resolved
+                    # record — the canonical mid-commit crash
+                    g.router.txn_call(0, "commit", txn, coord.gen)
+            assert len(plan.fired) == 1
+            wal = g.primaries[0].wal
+            tail_after_crash = wal.tail
+            # recovery finds the commit decision and the journaled
+            # commit-begin fence: the WAL scan sees both ops already
+            # applied and replays NOTHING
+            res = g.resolve_in_doubt()
+            assert res[0][txn] == "commit"
+            assert wal.tail == tail_after_crash  # zero re-appends
+            assert _read(g, 2) == 9 and _read(g, 4) == 11
+            assert int(g.router.call((HM_PUT, 2, 10))) >= 0  # unlocked
+        finally:
+            g.close()
+
+    def test_redriven_commit_verb_dedups_after_mid_commit_crash(
+            self, tmp_path):
+        # the OTHER recovery path: a restarted coordinator re-drives
+        # the published commit through the `commit` VERB (not
+        # `resolve_in_doubt`) — the journaled commit-begin fence must
+        # make that re-drive dedup too, or the participant that died
+        # between apply and resolved-record applies twice (found by
+        # `bench.py --txn`'s mid-commit SIGKILL round)
+        g = _group(tmp_path)
+        try:
+            coord = g.coordinator()
+            txn = f"{coord.name}.g{coord.gen}.1"
+            g.router.txn_call(0, "prepare", txn, coord.gen,
+                              ops=[(HM_PUT, 2, 9), (HM_PUT, 4, 11)])
+            g.decisions.publish(txn, "commit", shards=(0,))
+            plan = FaultPlan([FaultSpec(site="txn-commit",
+                                        action="raise", rid=0)])
+            with plan.armed():
+                with pytest.raises(Exception):
+                    g.router.txn_call(0, "commit", txn, coord.gen)
+            wal = g.primaries[0].wal
+            tail_after_crash = wal.tail
+            out = g.router.txn_call(0, "commit", txn, coord.gen)
+            assert wal.tail == tail_after_crash  # zero re-appends
+            assert len(out) == 2                 # results re-delivered
+            assert _read(g, 2) == 9 and _read(g, 4) == 11
+            # and the re-drive resolved it: a third commit is a no-op
+            assert g.router.txn_call(0, "commit", txn, coord.gen) == []
+        finally:
+            g.close()
+
+
+# ==========================================================================
+# coordinator: atomicity, degrade, decision-before-ack, recovery
+# ==========================================================================
+
+
+class TestCoordinator:
+    def test_cross_shard_txn_is_atomic(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            coord = g.coordinator()
+            out = coord.execute_txn([(HM_PUT, 2, 111), (HM_PUT, 3, 222)])
+            assert len(out) == 2
+            assert _read(g, 2) == 111 and _read(g, 3) == 222
+            # decision is durable and consultable after the fact
+            assert g.decisions.outcome(
+                f"{coord.name}.g{coord.gen}.1") == "commit"
+        finally:
+            g.close()
+
+    def test_single_shard_degrades_to_plain_batch(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            coord = g.coordinator()
+            coord.execute_txn([(HM_PUT, 2, 5), (HM_PUT, 4, 6)])
+            # no decision record: this was never a 2PC transaction
+            assert list(g.decisions.decisions()) == []
+            assert _read(g, 2) == 5 and _read(g, 4) == 6
+        finally:
+            g.close()
+
+    def test_conflict_aborts_whole_txn_with_zero_effect(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            coord = g.coordinator()
+            # lock a shard-1 key under a foreign prepared txn, so the
+            # coordinator's shard-1 prepare must refuse
+            g.router.txn_call(1, "prepare", "other.g1.1", coord.gen,
+                              ops=[(HM_PUT, 3, 1)])
+            with pytest.raises(TxnAborted):
+                coord.execute_txn([(HM_PUT, 2, 111), (HM_PUT, 3, 222)])
+            # all-or-nothing: the shard-0 half must NOT have applied
+            assert _read(g, 2) == -1 and _read(g, 3) == -1
+            # and the abort decision was published as an accelerator
+            assert g.decisions.outcome(
+                f"{coord.name}.g{coord.gen}.1") == "abort"
+            # the foreign intent keeps its lock (its txn, its keys)
+            with pytest.raises(TxnConflict):
+                g.router.call((HM_PUT, 3, 5))
+        finally:
+            g.close()
+
+    def test_coordinator_crash_after_decision_recovers(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            c1 = g.coordinator()
+            txn = f"{c1.name}.g{c1.gen}.1"
+            # simulate a coordinator that died between the durable
+            # decision publish and phase 2: prepares + decision only
+            g.router.txn_call(0, "prepare", txn, c1.gen,
+                              ops=[(HM_PUT, 2, 9)])
+            g.router.txn_call(1, "prepare", txn, c1.gen,
+                              ops=[(HM_PUT, 3, 8)])
+            g.decisions.publish(txn, "commit", shards=(0, 1))
+            c2 = g.coordinator(name="c2")
+            rep = c2.recover()
+            assert rep["redriven"] >= 2 and rep["failed"] == 0
+            assert _read(g, 2) == 9 and _read(g, 3) == 8
+            assert g.resolve_in_doubt() == {0: {}, 1: {}}
+        finally:
+            g.close()
+
+    def test_submit_txn_future_resolves_after_decision(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            coord = g.coordinator()
+            fut = coord.submit_txn([(HM_PUT, 2, 1), (HM_PUT, 3, 2)])
+            assert fut.result(10.0) == [0, 0]
+            assert g.decisions.outcome(
+                f"{coord.name}.g{coord.gen}.1") == "commit"
+        finally:
+            g.close()
+
+
+# ==========================================================================
+# reshard: live split, quiesced merge
+# ==========================================================================
+
+
+class TestReshard:
+    def test_split_moves_class_with_zero_lost_acks(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            for k in range(32):
+                g.router.call((HM_PUT, k, k * 10 + 1))
+            stop = threading.Event()
+            acked: dict[int, int] = {}
+            errs: list = []
+
+            # a generous budget: retries must absorb the whole fence
+            # window (catch-up + promote + map publish), which the
+            # default 8-attempt policy only just covers on a quiet box
+            ride = RetryPolicy(max_attempts=512, base_backoff_s=0.001,
+                               max_backoff_s=0.05)
+
+            def writer():
+                i = 0
+                while not stop.is_set():
+                    k = (i * 2) % 32  # donor's congruence class
+                    try:
+                        call_with_retry(g.router, (HM_PUT, k, 7000 + i),
+                                        policy=ride, deadline_s=30.0)
+                        acked[k] = 7000 + i
+                    except Exception as e:  # pragma: no cover
+                        errs.append(e)
+                        return
+                    i += 1
+                    time.sleep(0.001)
+
+            th = threading.Thread(target=writer, name="test-reshard-w")
+            th.start()
+            time.sleep(0.1)
+            plan = ReshardPlan(g, donor=0)
+            rep = plan.split()
+            time.sleep(0.1)
+            stop.set()
+            th.join(timeout=10)
+            assert not errs
+            assert g.map.n_shards == 4
+            assert rep.new_version == rep.old_version + 1
+            # the published map converged too
+            from node_replication_tpu.shard import ShardMap
+            assert ShardMap.load(str(tmp_path)).version == rep.new_version
+
+            def rd(k):
+                s = g.map.shard_of(k)
+                if s == 2:  # the moved class rides the recipient
+                    return int(plan._recipient.frontend.read((HM_GET, k)))
+                fe = g.primaries[s % 2].live_frontend
+                return int(fe.read((HM_GET, k)))
+
+            # ZERO lost acks across the cutover...
+            assert all(rd(k) == v for k, v in acked.items())
+            # ...and the untouched class is untouched
+            assert all(rd(k) == k * 10 + 1 for k in range(1, 32, 2))
+            # new writes route to the recipient
+            call_with_retry(g.router, (HM_PUT, 2, 4242))
+            assert rd(2) == 4242
+            # bounded fence window, not state-sized (split's own
+            # catch-up/drain timeouts are 10s; anything under that
+            # proves the fence is bounded by config, not by history)
+            assert rep.fence_s < 10.0
+        finally:
+            g.close()
+
+    def test_split_then_merge_round_trips(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            for k in range(16):
+                g.router.call((HM_PUT, k, 100 + k))
+            plan = ReshardPlan(g, donor=0)
+            plan.split()
+            call_with_retry(g.router, (HM_PUT, 2, 999))  # recipient write
+            rep = plan.merge()
+            assert g.map.n_shards == 2
+            assert rep.drained_records > 0
+            # folded values visible at the survivor, including the
+            # post-split write
+            assert _read(g, 2) == 999
+            assert all(_read(g, k) == 100 + k for k in range(16)
+                       if k != 2)
+        finally:
+            g.close()
+
+    def test_txn_spans_refined_topology(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            ReshardPlan(g, donor=0).split()
+            coord = g.coordinator()
+            # classes 1 and 2 of 4: one alias shard, one recipient
+            coord.execute_txn([(HM_PUT, 5, 1), (HM_PUT, 6, 2)])
+            fe1 = g.primaries[1].live_frontend
+            assert int(fe1.read((HM_GET, 5))) == 1
+        finally:
+            g.close()
+
+    def test_split_refuses_inflight_txn_and_dead_donor(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            g.router.txn_call(0, "prepare", "c.g1.1", 1,
+                              ops=[(HM_PUT, 2, 9)])
+            with pytest.raises(ReshardError):
+                ReshardPlan(g, donor=0).split()
+            g.router.txn_call(0, "abort", "c.g1.1", 1)
+            g.kill_primary(0)
+            with pytest.raises(ReshardError):
+                ReshardPlan(g, donor=0).split()
+        finally:
+            g.close()
+
+    def test_plan_is_single_use(self, tmp_path):
+        g = _group(tmp_path)
+        try:
+            plan = ReshardPlan(g, donor=0)
+            with pytest.raises(ReshardError):
+                plan.merge()  # nothing split yet
+            plan.split()
+            with pytest.raises(ReshardError):
+                plan.split()
+        finally:
+            g.close()
